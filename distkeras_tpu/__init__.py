@@ -1,0 +1,9 @@
+"""distkeras_tpu — a TPU-native distributed training framework with the
+capability surface of dist-keras (see SURVEY.md): a uniform Trainer API over
+data-parallel distributed optimizers (SingleTrainer, sync-DP, DOWNPOUR,
+ADAG, AEASGD, EAMSGD, DynSGD), columnar ETL transformers, and distributed
+batch inference — rebuilt on JAX/XLA (shard_map/pjit over a device mesh,
+ICI collectives) instead of Spark executors + a TCP parameter server.
+"""
+
+from distkeras_tpu.version import __version__  # noqa: F401

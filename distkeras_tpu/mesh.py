@@ -1,0 +1,98 @@
+"""L0 runtime: device mesh construction and worker-axis placement.
+
+TPU-native replacement for the reference's cluster substrate (SURVEY.md §1
+L0: Spark executors scheduled by the JVM).  Here "a worker" is a slice of a
+``jax.sharding.Mesh``: data-parallel workers live along the ``workers`` axis
+and exchange state over ICI via XLA collectives instead of TCP sockets to a
+driver thread (SURVEY.md §2.4).
+
+Single-chip emulation: when the requested worker count exceeds the device
+count, workers fold into a leading batch axis handled by ``vmap`` on one
+device — the ``local[N]`` analogue the reference got from Spark
+(SURVEY.md §4 "multi-node without a cluster").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+MODEL_AXIS = "model"
+
+
+def create_mesh(num_workers: int | None = None,
+                model_parallel: int = 1,
+                devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a ``(workers, model)`` mesh over the available devices.
+
+    ``num_workers`` defaults to ``len(devices) // model_parallel``.  The
+    worker axis is the data-parallel axis (the analogue of the reference's
+    ``num_workers`` Spark partitions); the model axis hosts tensor
+    parallelism for models that shard parameters.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_workers is None:
+        num_workers = max(1, len(devices) // model_parallel)
+    need = num_workers * model_parallel
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices ({num_workers} workers x "
+            f"{model_parallel} model-parallel), have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(num_workers, model_parallel)
+    return Mesh(grid, (WORKER_AXIS, MODEL_AXIS))
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerPlacement:
+    """How the emulated worker axis maps onto hardware.
+
+    ``mesh_workers`` workers are real mesh rows (SPMD over ICI);
+    ``vmap_workers`` further workers are folded per-device via ``vmap`` —
+    total emulated workers = mesh_workers * vmap_workers.
+    """
+
+    mesh: Mesh | None
+    mesh_workers: int
+    vmap_workers: int
+
+    @property
+    def num_workers(self) -> int:
+        return self.mesh_workers * self.vmap_workers
+
+
+def place_workers(num_workers: int,
+                  devices: Sequence[jax.Device] | None = None
+                  ) -> WorkerPlacement:
+    """Choose a placement for ``num_workers`` data-parallel workers.
+
+    Uses as many real devices as divide the worker count; the remainder is
+    emulated with ``vmap`` (single-chip development, the reference's
+    ``local[N]`` mode).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = len(devices)
+    mesh_workers = 1
+    for cand in range(min(n_dev, num_workers), 0, -1):
+        if num_workers % cand == 0:
+            mesh_workers = cand
+            break
+    vmap_workers = num_workers // mesh_workers
+    mesh = None
+    if mesh_workers > 1:
+        mesh = Mesh(np.asarray(devices[:mesh_workers]), (WORKER_AXIS,))
+    return WorkerPlacement(mesh=mesh, mesh_workers=mesh_workers,
+                           vmap_workers=vmap_workers)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis across workers."""
+    return NamedSharding(mesh, P(WORKER_AXIS))

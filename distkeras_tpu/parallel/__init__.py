@@ -1,0 +1,10 @@
+from distkeras_tpu.parallel.update_rules import (  # noqa: F401
+    RULES,
+    AdagRule,
+    DownpourRule,
+    DynSGDRule,
+    ElasticRule,
+    PSState,
+    UpdateRule,
+    apply_commit_round,
+)

@@ -1,0 +1,205 @@
+"""Parameter-server update rules as pure, jittable pytree functions.
+
+This is the portable essence of the reference's ``distkeras/
+parameter_servers.py`` + the server-relevant half of ``workers.py``
+(SURVEY.md §2.1): DOWNPOUR, ADAG, AEASGD, EAMSGD and DynSGD are each a
+*(commit payload, server update, worker pull)* triple, parameterized by a
+communication window and (for DynSGD) commit staleness.  The reference
+implements these as mutating methods on a threaded TCP server; here they are
+pure functions over parameter pytrees so they can be
+
+  * unit-tested directly against the published update equations,
+  * ``lax.scan``-ed over an in-round commit order (the on-mesh async
+    emulator in ``ps_emulator.py``), and
+  * closed into weighted ``psum``s where the rule is linear in the payload
+    (the fast path — see ``ps_emulator.py``).
+
+Staleness model: within an emulated round every worker pulls the center,
+runs ``communication_window`` local steps, and the parameter server applies
+the resulting commits in a (per-round permuted) order.  The i-th commit in
+that order has observed ``i`` intervening commits since its pull, so its
+staleness is exactly ``i`` — the same quantity the reference's DynSGD server
+tracks with its global update counter, but deterministic and replayable
+instead of a race outcome.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.utils import tree_add, tree_axpy, tree_lerp
+
+Pytree = Any
+
+
+class PSState(NamedTuple):
+    """Server-side state: the center variable plus a commit clock.
+
+    ``clock`` mirrors the reference DynSGD server's global update counter
+    (SURVEY.md §2.1 DynSGDParameterServer).
+    """
+
+    center: Pytree
+    clock: jnp.ndarray  # scalar int32, total commits applied
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateRule:
+    """Base class. Subclasses define the server commit + worker pull laws."""
+
+    #: 'delta' — worker commits (local - last_pulled); 'params' — worker
+    #: commits its full local parameters (elastic family).  Per-class
+    #: constant, not a constructor argument.
+    payload_kind: ClassVar[str] = "delta"
+
+    def init_state(self, center: Pytree) -> PSState:
+        return PSState(center=center, clock=jnp.zeros((), jnp.int32))
+
+    def commit(self, state: PSState, payload: Pytree,
+               staleness: jnp.ndarray) -> PSState:
+        raise NotImplementedError
+
+    def worker_pull(self, local: Pytree, center_pre: Pytree,
+                    center_post: Pytree) -> Pytree:
+        """New local params after this worker's own commit.
+
+        ``center_pre``/``center_post`` are the center immediately before /
+        after the worker's commit was applied.  Default (DOWNPOUR-family)
+        behavior is the reference's commit-then-pull: adopt the center as of
+        just after our commit (later commits in the round will be seen at
+        the next pull — i.e. next round).
+        """
+        del local, center_pre
+        return center_post
+
+    def normalize_delta(self, delta: Pytree, window: int) -> Pytree:
+        """Worker-side transform of the accumulated delta before commit."""
+        del window
+        return delta
+
+
+@dataclasses.dataclass(frozen=True)
+class DownpourRule(UpdateRule):
+    """DOWNPOUR (Dean et al., 2012): ``center += delta``.
+
+    The worker accumulates ``communication_window`` optimizer steps locally;
+    the commit payload is the raw parameter delta.  Reference:
+    DeltaParameterServer.commit (SURVEY.md §2.1).
+    """
+
+    def commit(self, state, payload, staleness):
+        del staleness
+        return PSState(center=tree_add(state.center, payload),
+                       clock=state.clock + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdagRule(UpdateRule):
+    """ADAG / accumulated-gradient-normalization (Hermans).
+
+    The worker normalizes its accumulated delta by the communication window
+    before committing; the server applies it additively.  This keeps the
+    effective per-commit step size independent of the window, which is what
+    lets ADAG tolerate large windows (the reference repo's flagship claim).
+
+    NOTE(provenance): the reference mount was empty (SURVEY.md header), so
+    the exact ADAG normalization could not be re-verified against
+    ``parameter_servers.py``; this implements the documented
+    delta/window normalization with additive server apply.
+    """
+
+    def commit(self, state, payload, staleness):
+        del staleness
+        return PSState(center=tree_add(state.center, payload),
+                       clock=state.clock + 1)
+
+    def normalize_delta(self, delta, window):
+        return jax.tree_util.tree_map(lambda d: d / float(window), delta)
+
+
+@dataclasses.dataclass(frozen=True)
+class DynSGDRule(UpdateRule):
+    """DynSGD: staleness-aware dynamic learning rate.
+
+    ``center += delta / (staleness + 1)`` — the reference's
+    DynSGDParameterServer scales each commit by the inverse of its staleness
+    (number of commits applied since the committing worker's pull), tracked
+    via the global update counter (SURVEY.md §2.1).
+    """
+
+    def commit(self, state, payload, staleness):
+        scale = 1.0 / (staleness.astype(jnp.float32) + 1.0)
+        return PSState(center=tree_axpy(scale, payload, state.center),
+                       clock=state.clock + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticRule(UpdateRule):
+    """AEASGD / EAMSGD server law (Zhang, Choromanska & LeCun, 2015).
+
+    Every window the worker fetches the center and exchanges the elastic
+    force ``e = alpha * (x_i - center)``:
+
+        server:  center <- center + e        (= (1-alpha)*center + alpha*x_i)
+        worker:  x_i    <- x_i    - e
+
+    ``alpha = learning_rate * rho`` (the reference trainers take ``rho`` and
+    ``learning_rate`` kwargs — SURVEY.md §2.1 AEASGD/EAMSGD).  EAMSGD differs
+    from AEASGD only on the worker side (Nesterov momentum in the local
+    loop), so both share this rule.
+    """
+
+    alpha: float = 0.5
+    payload_kind: ClassVar[str] = "params"
+
+    def commit(self, state, payload, staleness):
+        del staleness
+        # center <- (1 - alpha) * center + alpha * x_i
+        return PSState(center=tree_lerp(state.center, payload, self.alpha),
+                       clock=state.clock + 1)
+
+    def worker_pull(self, local, center_pre, center_post):
+        del center_post
+        # x_i <- x_i - alpha * (x_i - center_pre): symmetric elastic move
+        # against the same center value the server used for this commit.
+        return tree_lerp(local, center_pre, self.alpha)
+
+
+def apply_commit_round(rule: UpdateRule, state: PSState,
+                       payloads: Pytree) -> tuple[PSState, Pytree, Pytree]:
+    """Apply one round of N commits sequentially (the emulated PS loop).
+
+    ``payloads`` is a pytree whose leaves are stacked ``[N, ...]`` in commit
+    order.  Returns ``(new_state, centers_pre, centers_post)`` where
+    ``centers_pre``/``centers_post`` hold, for each commit i, the center
+    immediately before/after that commit (stacked ``[N, ...]``) — the values
+    each worker's pull law needs.
+
+    This is the semantically-faithful path (exactly the reference's
+    handler-thread serialization of commits, minus the race
+    nondeterminism).  The fast path for linear rules lives in
+    ``ps_emulator.weighted_psum_round``.
+    """
+
+    base_clock = state.clock
+
+    def step(st, payload_i):
+        staleness = st.clock - base_clock
+        new_st = rule.commit(st, payload_i, staleness)
+        return new_st, (st.center, new_st.center)
+
+    final_state, (pre, post) = jax.lax.scan(step, state, payloads)
+    return final_state, pre, post
+
+
+RULES = {
+    "downpour": DownpourRule,
+    "adag": AdagRule,
+    "dynsgd": DynSGDRule,
+    "aeasgd": ElasticRule,
+    "eamsgd": ElasticRule,
+}

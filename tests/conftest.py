@@ -1,0 +1,23 @@
+"""Test harness: 8 virtual CPU devices (the reference's ``local[N]`` mode).
+
+Must set flags before jax initializes (SURVEY.md §4: multi-device CPU mesh
+via ``--xla_force_host_platform_device_count`` is the Spark ``local[N]``
+analogue).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,97 @@
+"""Unit tests for the PS update-rule math (SURVEY.md §4: test update rules
+as pure functions — the reference never did)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.parallel import (
+    AdagRule,
+    DownpourRule,
+    DynSGDRule,
+    ElasticRule,
+    apply_commit_round,
+)
+
+
+def _params(val=0.0):
+    return {"w": jnp.full((3,), val), "b": jnp.full((2, 2), val)}
+
+
+def _leaf(tree):
+    return np.asarray(tree["w"])
+
+
+def test_downpour_commit_adds_delta():
+    rule = DownpourRule()
+    st = rule.init_state(_params(1.0))
+    st = rule.commit(st, _params(0.5), jnp.int32(0))
+    np.testing.assert_allclose(_leaf(st.center), 1.5)
+    assert int(st.clock) == 1
+
+
+def test_adag_normalizes_by_window():
+    rule = AdagRule()
+    delta = rule.normalize_delta(_params(8.0), window=4)
+    np.testing.assert_allclose(_leaf(delta), 2.0)
+    st = rule.commit(rule.init_state(_params(0.0)), delta, jnp.int32(0))
+    np.testing.assert_allclose(_leaf(st.center), 2.0)
+
+
+def test_dynsgd_scales_by_inverse_staleness():
+    rule = DynSGDRule()
+    st = rule.init_state(_params(0.0))
+    st = rule.commit(st, _params(1.0), jnp.int32(0))  # fresh: full step
+    np.testing.assert_allclose(_leaf(st.center), 1.0)
+    st = rule.commit(st, _params(1.0), jnp.int32(3))  # stale: 1/4 step
+    np.testing.assert_allclose(_leaf(st.center), 1.25)
+
+
+def test_elastic_symmetric_moves():
+    rule = ElasticRule(alpha=0.25)
+    center0 = _params(0.0)
+    local = _params(4.0)
+    st = rule.commit(rule.init_state(center0), local, jnp.int32(0))
+    # center moves alpha of the way toward the worker...
+    np.testing.assert_allclose(_leaf(st.center), 1.0)
+    # ...and the worker moves alpha of the way toward the (pre-commit) center
+    pulled = rule.worker_pull(local, center0, st.center)
+    np.testing.assert_allclose(_leaf(pulled), 3.0)
+
+
+def test_commit_round_matches_sequential_loop():
+    """lax.scan round == hand-rolled sequential commits, staleness=index."""
+    rule = DynSGDRule()
+    st0 = rule.init_state(_params(0.0))
+    n = 5
+    payloads = {
+        "w": jnp.stack([jnp.full((3,), float(i + 1)) for i in range(n)]),
+        "b": jnp.stack([jnp.full((2, 2), float(i + 1)) for i in range(n)]),
+    }
+    final, pre, post = apply_commit_round(rule, st0, payloads)
+
+    expect = rule.init_state(_params(0.0))
+    pres, posts = [], []
+    for i in range(n):
+        payload_i = jax.tree_util.tree_map(lambda x: x[i], payloads)
+        pres.append(_leaf(expect.center).copy())
+        expect = rule.commit(expect, payload_i, jnp.int32(i))
+        posts.append(_leaf(expect.center).copy())
+
+    np.testing.assert_allclose(_leaf(final.center), _leaf(expect.center),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pre["w"]), np.stack(pres),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(post["w"]), np.stack(posts),
+                               rtol=1e-6)
+    assert int(final.clock) == n
+
+
+def test_commit_round_is_jittable():
+    rule = ElasticRule(alpha=0.5)
+    st0 = rule.init_state(_params(0.0))
+    payloads = {"w": jnp.ones((4, 3)), "b": jnp.ones((4, 2, 2))}
+    jitted = jax.jit(lambda s, p: apply_commit_round(rule, s, p))
+    final, _, _ = jitted(st0, payloads)
+    # center after 4 elastic commits of x=1 from c=0: 1-(1-a)^4 = 0.9375
+    np.testing.assert_allclose(_leaf(final.center), 0.9375, rtol=1e-6)

@@ -1,0 +1,66 @@
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu import utils
+
+
+def _tree():
+    return {"a": jnp.arange(4.0), "b": {"w": jnp.ones((2, 2))}}
+
+
+def test_tree_arithmetic():
+    t = _tree()
+    z = utils.tree_zeros_like(t)
+    s = utils.tree_add(t, z)
+    np.testing.assert_allclose(s["a"], t["a"])
+    d = utils.tree_sub(t, t)
+    assert float(utils.tree_l2_norm(d)) == 0.0
+    scaled = utils.tree_scale(t, 2.0)
+    np.testing.assert_allclose(scaled["b"]["w"], 2 * np.ones((2, 2)))
+    lerped = utils.tree_lerp(z, t, 0.5)
+    np.testing.assert_allclose(lerped["a"], 0.5 * np.arange(4.0))
+
+
+def test_tree_size_and_dot():
+    t = _tree()
+    assert utils.tree_size(t) == 8
+    assert float(utils.tree_dot(t, t)) == float(
+        np.sum(np.arange(4.0) ** 2) + 4.0)
+
+
+def test_params_serialization_roundtrip():
+    t = _tree()
+    data = utils.serialize_params(t)
+    assert isinstance(data, bytes)
+    restored = utils.deserialize_params(utils.tree_zeros_like(t), data)
+    np.testing.assert_allclose(restored["a"], t["a"])
+    np.testing.assert_allclose(restored["b"]["w"], t["b"]["w"])
+
+
+def test_model_config_roundtrip():
+    cfg = {"name": "mlp", "hidden": [64, 32], "classes": 10}
+    assert utils.deserialize_model_config(
+        utils.serialize_model_config(cfg)) == cfg
+
+
+def test_to_dense_vector():
+    v = utils.to_dense_vector(2, 4)
+    np.testing.assert_allclose(v, [0, 0, 1, 0])
+    m = utils.to_dense_vector([0, 3], 4)
+    assert m.shape == (2, 4)
+    assert m[1, 3] == 1.0
+
+
+def test_shuffle_keeps_alignment():
+    cols = {"x": np.arange(10), "y": np.arange(10) * 2}
+    out = utils.shuffle(cols, seed=1)
+    np.testing.assert_allclose(out["y"], out["x"] * 2)
+    assert not np.array_equal(out["x"], cols["x"])  # actually permuted
+
+
+def test_batch_iterator_and_padding():
+    cols = {"x": np.arange(10), "y": np.arange(10)}
+    batches = list(utils.batch_iterator(cols, 4))
+    assert len(batches) == 2 and batches[1]["x"][0] == 4
+    padded = utils.pad_to_multiple(np.ones((10, 3)), 8)
+    assert padded.shape == (16, 3)
