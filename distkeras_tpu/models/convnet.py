@@ -1,0 +1,41 @@
+"""ConvNet for the CIFAR-10 baseline config (BASELINE.md: "CIFAR-10 ConvNet
+with ADAG").  The reference's convnet examples use small Keras
+Conv2D/MaxPool stacks; this is a configurable flax equivalent whose conv
+widths stay MXU-friendly (multiples of 128 at the widest)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+
+@register_model("convnet")
+class ConvNet(nn.Module):
+    """Conv blocks (conv-relu-conv-relu-pool) + dense head."""
+
+    num_classes: int = 10
+    widths: Sequence[int] = (64, 128, 256)
+    dense: int = 256
+    dropout_rate: float = 0.0
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.astype(dtype)
+        for width in self.widths:
+            x = nn.Conv(width, (3, 3), padding="SAME", dtype=dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(width, (3, 3), padding="SAME", dtype=dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense, dtype=dtype)(x)
+        x = nn.relu(x)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

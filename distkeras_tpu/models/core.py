@@ -1,0 +1,87 @@
+"""Model registry + config (de)serialization.
+
+The reference serializes Keras graphs as architecture-JSON + weights
+(SURVEY.md §3.5, ``distkeras/utils.py: serialize_keras_model``).  The
+TPU-native analogue: a model *family name* + kwargs dict, JSON-serializable,
+resolved through a registry to a flax module.  No code travels; rebuilds are
+deterministic; weights are a separate msgpack pytree
+(``distkeras_tpu.utils.serialize_params``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+MODEL_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register_model(family: str):
+    """Class decorator: register a flax module under ``family``."""
+
+    def wrap(cls):
+        MODEL_REGISTRY[family] = cls
+        cls.family = family
+        return cls
+
+    return wrap
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """A serializable model description: family + constructor kwargs +
+    an example input shape (without the batch dim) used for init."""
+
+    family: str
+    kwargs: Mapping[str, Any]
+    input_shape: tuple[int, ...]
+    input_dtype: str = "float32"
+
+    def to_config(self) -> dict:
+        return {
+            "family": self.family,
+            "kwargs": dict(self.kwargs),
+            "input_shape": list(self.input_shape),
+            "input_dtype": self.input_dtype,
+        }
+
+    @staticmethod
+    def from_config(config: Mapping[str, Any]) -> "ModelSpec":
+        return ModelSpec(
+            family=config["family"],
+            kwargs=dict(config.get("kwargs", {})),
+            input_shape=tuple(config["input_shape"]),
+            input_dtype=config.get("input_dtype", "float32"),
+        )
+
+    def build(self):
+        return build_model(self.to_config())
+
+    def example_input(self, batch_size: int = 2):
+        return np.zeros((batch_size, *self.input_shape),
+                        dtype=self.input_dtype)
+
+
+def model_config(family: str, input_shape: tuple[int, ...],
+                 input_dtype: str = "float32", **kwargs) -> dict:
+    return ModelSpec(family, kwargs, tuple(input_shape),
+                     input_dtype).to_config()
+
+
+def build_model(config: Mapping[str, Any]):
+    """Config dict -> flax module (the ``model_from_json`` analogue)."""
+    family = config["family"]
+    if family not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model family {family!r}; known: "
+            f"{sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[family](**config.get("kwargs", {}))
+
+
+def init_model(model, rng: jax.Array, sample_input, train: bool = False):
+    """Initialize variables. Returns the full variable dict
+    (``{'params': ..., possibly 'batch_stats': ...}``)."""
+    return model.init(rng, sample_input, train=train)

@@ -1,0 +1,52 @@
+"""BiLSTM text classifier for the IMDB baseline config (BASELINE.md:
+"IMDB BiLSTM with DynSGD").  The reference handles sequence workloads as
+plain Keras models inside each worker (SURVEY.md §5 "long-context: absent");
+here the recurrence is a ``flax.linen.RNN`` (lax.scan under jit — static
+shapes, no per-step Python)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+
+@register_model("bilstm")
+class BiLSTMClassifier(nn.Module):
+    """Embed -> BiLSTM -> masked mean-pool -> dense head.
+
+    Token id 0 is treated as padding and masked out of the pool.
+    """
+
+    vocab_size: int = 20000
+    embed_dim: int = 128
+    hidden_dim: int = 128
+    num_classes: int = 2
+    dropout_rate: float = 0.0
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        tokens = tokens.astype(jnp.int32)
+        mask = (tokens != 0).astype(dtype)[..., None]  # [B, T, 1]
+
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=dtype)(tokens)
+
+        # seq_lengths keeps the recurrence padding-invariant: the reverse
+        # pass starts at each sequence's last valid token, not at the pad.
+        lengths = jnp.sum(tokens != 0, axis=1)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=dtype))
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, dtype=dtype),
+                     reverse=True, keep_order=True)
+        x = jnp.concatenate([fwd(x, seq_lengths=lengths),
+                             bwd(x, seq_lengths=lengths)], axis=-1)
+
+        x = jnp.sum(x * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1.0)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.hidden_dim, dtype=dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,33 @@
+"""MLP for the MNIST baseline config (BASELINE.md: "MNIST MLP,
+SingleTrainer").  The reference's MNIST notebook builds a small Keras
+``Sequential`` dense stack; this is the flax equivalent."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+
+@register_model("mlp")
+class MLP(nn.Module):
+    """Dense stack: [hidden...] -> num_classes logits."""
+
+    num_classes: int = 10
+    hidden: Sequence[int] = (500, 500)
+    dropout_rate: float = 0.0
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
+        for width in self.hidden:
+            x = nn.Dense(width, dtype=dtype)(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

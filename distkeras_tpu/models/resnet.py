@@ -1,0 +1,143 @@
+"""ResNet family for the ImageNet baseline config (BASELINE.md: "ResNet-50 /
+ImageNet with AEASGD on v4-32 … ≥60% MFU").
+
+TPU-first choices:
+  * compute dtype defaults to bfloat16 (MXU-native), params stay float32;
+  * norm defaults to GroupNorm — stateless, so parameters are a pure pytree
+    and every PS update rule applies unchanged.  BatchNorm is available
+    (``norm='batch'``) and its running stats ride the ``batch_stats``
+    collection, which trainers keep worker-local (SURVEY.md §7 L1).
+  * NHWC layout throughout (XLA:TPU's preferred conv layout).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+ModuleDef = Any
+
+
+class AdaptiveGroupNorm(nn.Module):
+    """GroupNorm with group count adapted to the channel width (gcd with 32)
+    so narrow stems/test widths still divide evenly."""
+
+    dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, x):
+        groups = math.gcd(32, x.shape[-1])
+        return nn.GroupNorm(num_groups=groups, dtype=self.dtype,
+                            scale_init=self.scale_init)(x)
+
+
+def _norm(norm: str, dtype, train: bool) -> Callable:
+    if norm == "batch":
+        return functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5, dtype=dtype)
+    if norm == "group":
+        return functools.partial(AdaptiveGroupNorm, dtype=dtype)
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int]
+    norm: ModuleDef
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int]
+    norm: ModuleDef
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        # zero-init the last norm's scale so blocks start as identity
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+@register_model("resnet")
+class ResNet(nn.Module):
+    """Configurable ResNet; ``stage_sizes=(3,4,6,3), bottleneck=True`` is
+    ResNet-50."""
+
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    width: int = 64
+    norm: str = "group"
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        norm = _norm(self.norm, dtype, train)
+        block = BottleneckBlock if self.bottleneck else BasicBlock
+
+        x = x.astype(dtype)
+        x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, size in enumerate(self.stage_sizes):
+            for i in range(size):
+                strides = (2, 2) if stage > 0 and i == 0 else (1, 1)
+                x = block(filters=self.width * 2 ** stage, strides=strides,
+                          norm=norm, dtype=dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def ResNet18(**kw) -> ResNet:
+    kw.setdefault("stage_sizes", (2, 2, 2, 2))
+    kw.setdefault("bottleneck", False)
+    return ResNet(**kw)
+
+
+def ResNet50(**kw) -> ResNet:
+    kw.setdefault("stage_sizes", (3, 4, 6, 3))
+    kw.setdefault("bottleneck", True)
+    return ResNet(**kw)

@@ -1,0 +1,100 @@
+"""Decoder-only Transformer LM — the long-context flagship.
+
+The reference has no long-sequence story (SURVEY.md §5: "long-context /
+sequence parallelism: absent"); the TPU rebuild makes it first-class.  The
+attention op is pluggable: dense causal attention on a single device, or
+ring attention over a ``seq`` mesh axis (``distkeras_tpu.parallel.
+ring_attention``) when the trainer shards the sequence dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+AttnFn = Callable[..., jnp.ndarray]
+
+
+def dense_causal_attention(q, k, v, *, scale):
+    """Plain causal attention: [B, T, H, D] -> [B, T, H, D]."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    t = q.shape[1]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError(
+                f"d_model={d_model} not divisible by "
+                f"num_heads={self.num_heads}")
+        head_dim = d_model // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), dtype=self.dtype, name=name)
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        attn = self.attn_fn or dense_causal_attention
+        out = attn(q, k, v, scale=head_dim ** -0.5)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int
+    dtype: jnp.dtype
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn)(y)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d_model, dtype=self.dtype)(y)
+        return x + y
+
+
+@register_model("transformer_lm")
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    dtype: str = "bfloat16"
+    attn_fn: Optional[AttnFn] = None  # None -> dense causal
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        tokens = tokens.astype(jnp.int32)
+        t = tokens.shape[1]
+        if t > self.max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds max_len={self.max_len}")
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=dtype,
+                       name="pos_embed")(jnp.arange(t)[None, :])
+        x = x + pos
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, self.mlp_ratio, dtype,
+                      self.attn_fn)(x)
+        x = nn.LayerNorm(dtype=dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        name="lm_head")(x)

@@ -1,0 +1,59 @@
+"""Wide & Deep for the Criteo baseline config (BASELINE.md: "Criteo
+Wide&Deep — DataFrame ETL -> TPU train/predict").
+
+Input is a single float matrix ``[B, num_dense + num_categorical]`` as
+produced by the ETL transformer pipeline (``distkeras_tpu.data``): the first
+``num_dense`` columns are normalized dense features, the rest are integer
+category ids (already hash-bucketed by ``HashBucketTransformer``).  One
+matrix in, logits out, so the trainer/predictor surface is identical to the
+other model families.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import register_model
+
+
+@register_model("wide_deep")
+class WideAndDeep(nn.Module):
+    num_dense: int = 13
+    num_categorical: int = 26
+    vocab_size: int = 10000       # per-feature hash bucket count
+    embed_dim: int = 16
+    deep: Sequence[int] = (256, 128, 64)
+    num_classes: int = 2
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        dense = x[:, :self.num_dense].astype(dtype)
+        cats = x[:, self.num_dense:].astype(jnp.int32)  # [B, C]
+        cats = jnp.clip(cats, 0, self.vocab_size - 1)
+
+        # Wide arm: linear over one-hot categoricals == per-feature scalar
+        # embedding lookup (avoids materializing the one-hot).
+        wide_tab = nn.Embed(self.num_categorical * self.vocab_size,
+                            self.num_classes, dtype=dtype,
+                            name="wide_table")
+        offsets = jnp.arange(self.num_categorical) * self.vocab_size
+        wide = jnp.sum(wide_tab(cats + offsets[None, :]), axis=1)
+        wide = wide + nn.Dense(self.num_classes, dtype=dtype,
+                               name="wide_dense")(dense)
+
+        # Deep arm: concatenated embeddings + dense features -> MLP.
+        deep_tab = nn.Embed(self.num_categorical * self.vocab_size,
+                            self.embed_dim, dtype=dtype, name="deep_table")
+        emb = deep_tab(cats + offsets[None, :])  # [B, C, E]
+        h = jnp.concatenate(
+            [emb.reshape((x.shape[0], -1)), dense], axis=-1)
+        for width in self.deep:
+            h = nn.Dense(width, dtype=dtype)(h)
+            h = nn.relu(h)
+        deep = nn.Dense(self.num_classes, dtype=jnp.float32)(h)
+        return wide.astype(jnp.float32) + deep
