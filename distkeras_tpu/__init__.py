@@ -7,3 +7,23 @@ ICI collectives) instead of Spark executors + a TCP parameter server.
 """
 
 from distkeras_tpu.version import __version__  # noqa: F401
+from distkeras_tpu import data, mesh, models, ops, parallel  # noqa: F401
+from distkeras_tpu.trainers import (  # noqa: F401
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DistributedTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    SyncTrainer,
+    Trainer,
+)
+from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
+from distkeras_tpu.evaluators import (  # noqa: F401
+    AccuracyEvaluator,
+    LossEvaluator,
+    evaluate_model,
+)

@@ -1,0 +1,22 @@
+"""Columnar data layer: the TPU-native replacement for the reference's
+Spark-DataFrame substrate (SURVEY.md §1 L0/L6).
+
+The reference's ETL is Spark-ML transformers mapping rows of a DataFrame;
+ours is the same *semantics* over a columnar, numpy-backed ``Dataset`` —
+vectorized, static-shape, host-side — feeding device-sharded batches
+(SURVEY.md §7 "keep the transformer semantics, not the engine").
+"""
+
+from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.transformers import (  # noqa: F401
+    DenseTransformer,
+    HashBucketTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    Pipeline,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+    Transformer,
+)
+from distkeras_tpu.data import datasets  # noqa: F401

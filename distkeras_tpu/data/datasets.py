@@ -1,0 +1,98 @@
+"""Synthetic dataset generators for the five baseline configs
+(BASELINE.md).  The reference's examples download MNIST / ATLAS Higgs /
+Criteo; with zero egress the rebuild generates *learnable* synthetic stand-
+ins (labels are a deterministic function of features, so convergence tests
+have signal), with the same column names the real loaders would produce:
+``features`` / ``label``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def _rng(seed: int) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+def synthetic_classification(num_rows: int, feature_shape: tuple[int, ...],
+                             num_classes: int, seed: int = 0) -> Dataset:
+    """Gaussian features; label = argmax of a fixed random linear map (a
+    learnable, well-conditioned signal)."""
+    rng = _rng(seed)
+    x = rng.normal(size=(num_rows, *feature_shape)).astype(np.float32)
+    flat = x.reshape(num_rows, -1)
+    w = _rng(seed + 1).normal(size=(flat.shape[1], num_classes))
+    w /= np.sqrt(flat.shape[1])
+    label = np.argmax(flat @ w, axis=1).astype(np.int32)
+    return Dataset({"features": x, "label": label})
+
+
+def mnist_synth(num_rows: int = 4096, seed: int = 0) -> Dataset:
+    """MNIST-shaped: 28x28x1 in [0,1], 10 classes."""
+    ds = synthetic_classification(num_rows, (28, 28, 1), 10, seed)
+    return ds.map_column("features", lambda x: (x - x.min()) /
+                         (x.max() - x.min()))
+
+
+def cifar10_synth(num_rows: int = 4096, seed: int = 1) -> Dataset:
+    return synthetic_classification(num_rows, (32, 32, 3), 10, seed)
+
+
+def imagenet_synth(num_rows: int = 512, image_size: int = 224,
+                   num_classes: int = 1000, seed: int = 2) -> Dataset:
+    return synthetic_classification(num_rows,
+                                    (image_size, image_size, 3),
+                                    num_classes, seed)
+
+
+def imdb_synth(num_rows: int = 2048, seq_len: int = 64,
+               vocab_size: int = 1000, seed: int = 3) -> Dataset:
+    """Token sequences (0 = pad); label = whether "positive" tokens (ids
+    below vocab/2) outnumber "negative" ones — order-free but recurrent-
+    friendly signal."""
+    rng = _rng(seed)
+    lengths = rng.integers(seq_len // 2, seq_len + 1, size=num_rows)
+    tokens = rng.integers(1, vocab_size, size=(num_rows, seq_len))
+    mask = np.arange(seq_len)[None, :] < lengths[:, None]
+    tokens = (tokens * mask).astype(np.int32)
+    positive = ((tokens > 0) & (tokens < vocab_size // 2)).sum(axis=1)
+    label = (positive * 2 > lengths).astype(np.int32)
+    return Dataset({"features": tokens, "label": label})
+
+
+def criteo_synth(num_rows: int = 4096, num_dense: int = 13,
+                 num_categorical: int = 26, vocab_size: int = 1000,
+                 seed: int = 4) -> Dataset:
+    """Criteo-shaped CTR rows: dense float features (log-normal, like
+    Criteo counts), string categoricals, binary label correlated with a
+    random subset of both."""
+    rng = _rng(seed)
+    dense = rng.lognormal(0.0, 1.0,
+                          size=(num_rows, num_dense)).astype(np.float32)
+    cats = rng.integers(0, vocab_size, size=(num_rows, num_categorical))
+    cat_strings = np.char.add("cat_", cats.astype(str))
+    w_dense = _rng(seed + 1).normal(size=num_dense)
+    score = np.log1p(dense) @ w_dense + (cats[:, 0] % 2) - 0.5
+    label = (score > np.median(score)).astype(np.int32)
+    cols = {"label": label}
+    cols["dense"] = dense
+    for j in range(num_categorical):
+        cols[f"c{j}"] = cat_strings[:, j]
+    return Dataset(cols)
+
+
+def lm_synth(num_rows: int = 1024, seq_len: int = 128,
+             vocab_size: int = 256, seed: int = 5) -> Dataset:
+    """Language-model rows for the Transformer: next-token targets over a
+    deterministic mod-arithmetic sequence (perfectly learnable)."""
+    rng = _rng(seed)
+    start = rng.integers(1, vocab_size, size=(num_rows, 2))
+    seq = np.zeros((num_rows, seq_len + 1), dtype=np.int64)
+    seq[:, :2] = start
+    for t in range(2, seq_len + 1):
+        seq[:, t] = (seq[:, t - 1] + seq[:, t - 2]) % vocab_size
+    return Dataset({"features": seq[:, :-1].astype(np.int32),
+                    "label": seq[:, 1:].astype(np.int32)})

@@ -1,0 +1,64 @@
+"""Evaluators — accuracy-style metrics over a ``Dataset``.
+
+The reference leaned on ``pyspark.ml`` evaluators in notebooks (SURVEY.md
+§2.1 Evaluators [LOW]); the rebuild ships its own so the pipeline is
+self-contained: an evaluator consumes a prediction column (from
+``ModelPredictor``) or runs the model itself, and returns a scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.predictors import ModelPredictor
+
+
+class AccuracyEvaluator:
+    """Classification accuracy from a prediction column.
+
+    Accepts class-id predictions (int) or logits/probabilities (argmax'd).
+    """
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred = np.asarray(dataset[self.prediction_col])
+        if pred.ndim > 1:
+            pred = np.argmax(pred, axis=-1)
+        labels = np.asarray(dataset[self.label_col])
+        return float(np.mean(pred == labels))
+
+
+class LossEvaluator:
+    """Mean of an arbitrary per-row loss ``fn(pred_col_value, label)``."""
+
+    def __init__(self, fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+                 prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.fn = fn
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        return float(np.mean(self.fn(
+            np.asarray(dataset[self.prediction_col]),
+            np.asarray(dataset[self.label_col]))))
+
+
+def evaluate_model(model, variables: Mapping, dataset: Dataset, *,
+                   features_col: str = "features",
+                   label_col: str = "label",
+                   batch_size: int = 512) -> dict[str, float]:
+    """One-call accuracy for a trained model (predict + evaluate)."""
+    predictor = ModelPredictor(model, variables,
+                               features_col=features_col,
+                               output="class", batch_size=batch_size)
+    scored = predictor.predict(dataset)
+    acc = AccuracyEvaluator("prediction", label_col).evaluate(scored)
+    return {"accuracy": acc}

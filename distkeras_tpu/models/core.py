@@ -50,9 +50,17 @@ class ModelSpec:
 
     @staticmethod
     def from_config(config: Mapping[str, Any]) -> "ModelSpec":
+        if "family" not in config:
+            raise KeyError(
+                "model config missing 'family' key; build configs with "
+                "distkeras_tpu.models.model_config")
+        # JSON turns tuples into lists; normalize back so a config that
+        # traveled rebuilds a module equal (and hashable) to the original.
+        kwargs = {k: tuple(v) if isinstance(v, list) else v
+                  for k, v in config.get("kwargs", {}).items()}
         return ModelSpec(
             family=config["family"],
-            kwargs=dict(config.get("kwargs", {})),
+            kwargs=kwargs,
             input_shape=tuple(config["input_shape"]),
             input_dtype=config.get("input_dtype", "float32"),
         )

@@ -1,0 +1,15 @@
+"""Compute ops: losses, eval metrics, and (Pallas) kernels.
+
+The reference has no op layer of its own — Keras/Theano supplied it
+(SURVEY.md §1 "no ops/kernel layer").  The rebuild's op layer is jittable
+functions over logits/labels, fused by XLA; hand-written Pallas kernels
+live in ``distkeras_tpu.ops.pallas_kernels`` for the cases XLA doesn't
+fuse well.
+"""
+
+from distkeras_tpu.ops.losses import LOSSES, resolve_loss  # noqa: F401
+from distkeras_tpu.ops.metrics import (  # noqa: F401
+    accuracy,
+    binary_accuracy,
+    top_k_accuracy,
+)

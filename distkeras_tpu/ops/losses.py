@@ -1,0 +1,71 @@
+"""Loss functions, resolvable by Keras-style string names.
+
+The reference passes Keras loss names through ``model.compile(loss=...)``
+(SURVEY.md §3.1); trainers here accept the same strings (or any callable
+``(logits, labels) -> scalar``).  All losses reduce to a batch mean and
+compute in float32 regardless of model compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import optax
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def categorical_crossentropy(logits: jnp.ndarray,
+                             labels: jnp.ndarray) -> jnp.ndarray:
+    """Cross-entropy from logits.  Accepts integer class labels (any
+    leading shape, e.g. [B] or [B, T]) or one-hot/soft labels with the
+    same shape as ``logits``."""
+    logits = logits.astype(jnp.float32)
+    if labels.ndim == logits.ndim:
+        per = optax.softmax_cross_entropy(logits,
+                                          labels.astype(jnp.float32))
+    else:
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32))
+    return per.mean()
+
+
+def binary_crossentropy(logits: jnp.ndarray,
+                        labels: jnp.ndarray) -> jnp.ndarray:
+    """Sigmoid cross-entropy from a single logit per row."""
+    logits = jnp.squeeze(logits.astype(jnp.float32), axis=-1) \
+        if logits.ndim > labels.ndim else logits.astype(jnp.float32)
+    return optax.sigmoid_binary_cross_entropy(
+        logits, labels.astype(jnp.float32)).mean()
+
+
+def mean_squared_error(pred: jnp.ndarray,
+                       target: jnp.ndarray) -> jnp.ndarray:
+    pred = pred.astype(jnp.float32)
+    return jnp.mean(jnp.square(pred - target.astype(jnp.float32)))
+
+
+def mean_absolute_error(pred: jnp.ndarray,
+                        target: jnp.ndarray) -> jnp.ndarray:
+    pred = pred.astype(jnp.float32)
+    return jnp.mean(jnp.abs(pred - target.astype(jnp.float32)))
+
+
+LOSSES: dict[str, LossFn] = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+}
+
+
+def resolve_loss(loss: str | LossFn) -> LossFn:
+    if callable(loss):
+        return loss
+    if loss not in LOSSES:
+        raise KeyError(f"unknown loss {loss!r}; known: {sorted(LOSSES)}")
+    return LOSSES[loss]

@@ -1,0 +1,31 @@
+"""Eval metrics (jittable).  The reference delegated evaluation to
+pyspark.ml evaluators in notebooks (SURVEY.md §2.1 Evaluators); here they
+are plain functions used by ``distkeras_tpu.evaluators``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of rows whose argmax matches the integer label."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred == labels.astype(pred.dtype))
+                    .astype(jnp.float32))
+
+
+def binary_accuracy(logits: jnp.ndarray,
+                    labels: jnp.ndarray) -> jnp.ndarray:
+    logits = jnp.squeeze(logits, axis=-1) if logits.ndim > labels.ndim \
+        else logits
+    pred = (logits > 0).astype(jnp.int32)
+    return jnp.mean((pred == labels.astype(jnp.int32))
+                    .astype(jnp.float32))
+
+
+def top_k_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                   k: int = 5) -> jnp.ndarray:
+    _, top = jax.lax.top_k(logits, k)
+    hit = jnp.any(top == labels[..., None].astype(top.dtype), axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

@@ -1,0 +1,161 @@
+"""On-mesh emulation of the asynchronous parameter server.
+
+The reference's PS is a threaded TCP server on the Spark driver: workers
+race to commit deltas, and staleness is whatever the race produced
+(SURVEY.md §3.2).  An XLA program is synchronous, so the rebuild makes the
+race *explicit*: each emulated round, every worker runs a communication
+window of local steps on its mesh slice, and the server applies the
+resulting commits in a per-round permuted order.  The i-th commit in that
+order has staleness i — the same quantity the reference's DynSGD server
+reads off its global update counter, but deterministic and replayable
+(SURVEY.md §7, design 5b).
+
+Two fidelities:
+
+* ``faithful`` — commits applied sequentially via ``lax.scan``
+  (``update_rules.apply_commit_round``); each worker's pull sees exactly
+  the center its commit position implies.  Bit-for-bit the reference's
+  handler-thread serialization, minus nondeterminism.  Materializes
+  ``[W, params]`` pre/post stacks — fine for small/medium models.
+* ``fast`` — closed-form equivalent for the linear rules: the round's
+  center update collapses to one weighted sum (a single ``psum``-shaped
+  reduction on the mesh), and every worker pulls the round-final center
+  (i.e. pulls are deferred to the round barrier; for the elastic family
+  the worker-side move uses the round-start center).  The *center*
+  trajectory is exact for DOWNPOUR/ADAG/DynSGD and exact-in-expectation
+  for the elastic family; only pull timing differs.  O(params) memory.
+
+Sharding: callers jit the returned round function with the stacked worker
+axis sharded over the mesh's ``workers`` axis (``distkeras_tpu.mesh``).
+XLA then lowers the payload reduction to an ICI all-reduce and the
+faithful path's gathers to all-gathers — the collective layout recommended
+by the scaling-book recipe (mesh + shardings, compiler inserts
+collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.parallel.update_rules import (
+    DynSGDRule,
+    ElasticRule,
+    PSState,
+    UpdateRule,
+    apply_commit_round,
+)
+from distkeras_tpu.utils import tree_sub
+from distkeras_tpu.workers import TrainState, make_window_runner
+
+Pytree = Any
+
+
+def _broadcast_like(tree: Pytree, num: int) -> Pytree:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (num, *x.shape)), tree)
+
+
+def _take(tree: Pytree, idx) -> Pytree:
+    return jax.tree_util.tree_map(lambda x: x[idx], tree)
+
+
+def make_round_fn(rule: UpdateRule, step_fn: Callable,
+                  fidelity: str = "faithful") -> Callable:
+    """Build the emulated-round function.
+
+    ``round_fn(ps_state, worker_states, batches, perm)`` where
+
+    * ``ps_state`` — ``PSState`` (center params + commit clock),
+    * ``worker_states`` — ``TrainState`` stacked ``[W, ...]``,
+    * ``batches`` — column dict, leaves ``[W, window, B, ...]``,
+    * ``perm`` — ``[W]`` int32, this round's commit order
+      (``perm[i]`` = worker committing i-th).
+
+    Returns ``(ps_state, worker_states, metrics)``; ``metrics`` includes
+    per-worker mean loss and the per-worker staleness this round.
+    """
+    if fidelity not in ("faithful", "fast"):
+        raise ValueError(f"unknown fidelity {fidelity!r}")
+    window_run = make_window_runner(step_fn)
+
+    def round_fn(ps_state: PSState, worker_states: TrainState,
+                 batches: Mapping[str, jnp.ndarray], perm: jnp.ndarray):
+        num_workers = perm.shape[0]
+        window = jax.tree_util.tree_leaves(batches)[0].shape[1]
+        center = ps_state.center
+
+        if rule.payload_kind == "delta":
+            # Round-start pull: every worker adopts the current center.
+            pulled = _broadcast_like(center, num_workers)
+            worker_states = worker_states.replace(params=pulled)
+
+        new_states, step_metrics = jax.vmap(window_run)(
+            worker_states, batches)
+
+        if rule.payload_kind == "delta":
+            payloads = rule.normalize_delta(
+                tree_sub(new_states.params, pulled), window)
+        else:
+            payloads = new_states.params
+
+        inv = jnp.argsort(perm)  # inv[w] = commit position of worker w
+
+        if fidelity == "faithful":
+            ordered = _take(payloads, perm)
+            ps_state, pre, post = apply_commit_round(rule, ps_state,
+                                                     ordered)
+            pulled_params = jax.vmap(rule.worker_pull)(
+                new_states.params, _take(pre, inv), _take(post, inv))
+        else:
+            ps_state, pulled_params = _fast_round(
+                rule, ps_state, payloads, new_states.params, inv,
+                num_workers)
+
+        new_states = new_states.replace(params=pulled_params)
+        metrics = {
+            "loss": step_metrics["loss"].mean(axis=1),        # [W]
+            "grad_norm": step_metrics["grad_norm"].mean(axis=1),
+            "staleness": inv.astype(jnp.int32),               # [W]
+        }
+        return ps_state, new_states, metrics
+
+    return round_fn
+
+
+def _fast_round(rule: UpdateRule, ps_state: PSState, payloads: Pytree,
+                local_params: Pytree, inv: jnp.ndarray, num_workers: int):
+    """Closed-form center update + deferred pulls (see module docstring)."""
+    center = ps_state.center
+    if isinstance(rule, ElasticRule):
+        # center_W = (1-a)^W c0 + a * sum_w (1-a)^(W-1-pos_w) * x_w
+        a = rule.alpha
+        decay = (1.0 - a) ** num_workers
+        w_coeff = a * (1.0 - a) ** (num_workers - 1.0
+                                    - inv.astype(jnp.float32))
+        new_center = jax.tree_util.tree_map(
+            lambda c, x: decay * c + jnp.tensordot(w_coeff, x, axes=1),
+            center, payloads)
+        # Worker move against the round-start center (pull-timing approx).
+        pulled = jax.vmap(
+            lambda local, c: rule.worker_pull(local, c, c),
+            in_axes=(0, None))(local_params, center)
+    else:
+        if isinstance(rule, DynSGDRule):
+            scale = 1.0 / (inv.astype(jnp.float32) + 1.0)
+        else:
+            scale = jnp.ones((num_workers,), jnp.float32)
+        new_center = jax.tree_util.tree_map(
+            lambda c, p: c + jnp.tensordot(scale, p, axes=1),
+            center, payloads)
+        pulled = _broadcast_like(new_center, num_workers)
+    new_ps = PSState(center=new_center,
+                     clock=ps_state.clock + num_workers)
+    return new_ps, pulled
+
+
+def commit_permutation(rng: jax.Array, num_workers: int) -> jnp.ndarray:
+    """Per-round commit order — the emulator's stand-in for the TCP race."""
+    return jax.random.permutation(rng, num_workers)

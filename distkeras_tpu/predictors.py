@@ -1,0 +1,98 @@
+"""Distributed batch inference — the reference's ``distkeras/predictors.py``
+(SURVEY.md §3.3: ``ModelPredictor.predict(df)`` maps the deserialized model
+over partitions, appending a prediction column).
+
+TPU-native: one jitted forward pass over batches whose leading axis is
+sharded across the mesh's worker axis (XLA shards the matmuls; no per-row
+Python).  Appends the prediction column to the ``Dataset`` and returns it —
+same DataFrame-in, DataFrame-out idiom.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import ModelSpec
+from distkeras_tpu.utils import pad_to_multiple
+
+
+class ModelPredictor:
+    """Append a prediction column (logits, probabilities, or class ids).
+
+    ``output`` selects the column semantics: ``"logits"``, ``"prob"``
+    (softmax), or ``"class"`` (argmax int32).
+    """
+
+    def __init__(self, model, variables: Mapping, *,
+                 features_col: str = "features",
+                 output_col: str = "prediction",
+                 output: str = "logits",
+                 batch_size: int = 512,
+                 num_shards: int | None = None):
+        if isinstance(model, ModelSpec):
+            self.spec = model
+        elif isinstance(model, Mapping):
+            self.spec = ModelSpec.from_config(model)  # raises if malformed
+        else:
+            self.spec = None
+            if not hasattr(model, "apply"):
+                raise TypeError(
+                    "model must be a ModelSpec, a model config dict, or a "
+                    f"flax module; got {type(model).__name__}")
+        self.model = self.spec.build() if self.spec is not None else model
+        self.variables = dict(variables)
+        self.features_col = features_col
+        self.output_col = output_col
+        if output not in ("logits", "prob", "class"):
+            raise ValueError(f"unknown output {output!r}")
+        self.output = output
+        self.batch_size = int(batch_size)
+
+        devices = jax.devices()
+        self.num_shards = num_shards or len(devices)
+        self._mesh = (mesh_lib.create_mesh(self.num_shards)
+                      if self.num_shards > 1
+                      and len(devices) >= self.num_shards else None)
+
+        def forward(variables, x):
+            logits = self.model.apply(variables, x, train=False)
+            if self.output == "prob":
+                return jax.nn.softmax(logits, axis=-1)
+            if self.output == "class":
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return logits
+
+        if self._mesh is not None:
+            rep = NamedSharding(self._mesh, P())
+            row = NamedSharding(self._mesh, P(mesh_lib.WORKER_AXIS))
+            self._forward = jax.jit(forward, in_shardings=(rep, row),
+                                    out_shardings=row)
+        else:
+            self._forward = jax.jit(forward)
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        n = len(dataset)
+        x = np.asarray(dataset[self.features_col])
+        # Pad to a full (sharded) batch so every device call has one static
+        # shape; strip padding after.
+        chunk = self.batch_size * max(self.num_shards, 1)
+        x = pad_to_multiple(x, chunk, axis=0)
+        outs = []
+        for lo in range(0, len(x), chunk):
+            outs.append(np.asarray(
+                self._forward(self.variables, jnp.asarray(
+                    x[lo:lo + chunk]))))
+        pred = np.concatenate(outs)[:n]
+        return dataset.with_column(self.output_col, pred)
+
+    # Spark-ML idiom alias (reference uses transformer-style `.predict`;
+    # pipelines compose via __call__)
+    def __call__(self, dataset: Dataset) -> Dataset:
+        return self.predict(dataset)

@@ -1,0 +1,440 @@
+"""Trainer hierarchy — the framework's front door.
+
+Mirrors the reference's ``distkeras/trainers.py`` surface (SURVEY.md §2.1):
+``SingleTrainer``, ``EnsembleTrainer``/``AveragingTrainer``, and the async
+parameter-server family ``DOWNPOUR`` / ``ADAG`` / ``AEASGD`` / ``EAMSGD`` /
+``DynSGD`` — plus the TPU-native ``SyncTrainer`` (synchronous data
+parallelism over ICI, the convergence control arm the reference lacked,
+SURVEY.md §2.3).
+
+Semantics map (reference -> rebuild):
+
+* Spark DataFrame             -> ``distkeras_tpu.data.Dataset``
+* ``num_workers`` partitions  -> slices of the device mesh's worker axis
+  (``distkeras_tpu.mesh``), emulated per-device via ``vmap`` when the
+  worker count exceeds the device count (Spark ``local[N]`` analogue)
+* TCP pull/commit to the driver PS -> emulated commit rounds compiled
+  on-mesh (``parallel.ps_emulator``) with deterministic staleness
+* ``communication_window``    -> window of jitted local steps per round
+* trained Keras model         -> flax variables dict (+ ``ModelSpec``)
+
+Every trainer records ``training_time`` (as the reference's ``Trainer``
+does) and a richer ``history`` (per-round losses, staleness telemetry —
+SURVEY.md §5 "honest observability").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import ModelSpec
+from distkeras_tpu.parallel.ps_emulator import make_round_fn
+from distkeras_tpu.parallel.update_rules import (
+    AdagRule,
+    DownpourRule,
+    DynSGDRule,
+    ElasticRule,
+    UpdateRule,
+)
+from distkeras_tpu.utils import tree_scale, tree_add
+from distkeras_tpu.workers import (
+    TrainState,
+    make_train_step,
+    make_window_runner,
+    resolve_optimizer,
+)
+
+Pytree = Any
+
+
+def _resolve_spec(model) -> ModelSpec:
+    if isinstance(model, ModelSpec):
+        return model
+    if isinstance(model, Mapping):
+        return ModelSpec.from_config(model)
+    raise TypeError(
+        "model must be a ModelSpec or a model config dict "
+        "(distkeras_tpu.models.model_config); got "
+        f"{type(model).__name__}")
+
+
+def _stack_batches(shard: Dataset, batch_size: int,
+                   columns: Sequence[str]) -> dict[str, np.ndarray] | None:
+    """Rows -> stacked batch arrays ``[num_batches, B, ...]``."""
+    n = shard.num_batches(batch_size)
+    if n == 0:
+        return None
+    out = {}
+    for c in columns:
+        col = shard[c][:n * batch_size]
+        out[c] = col.reshape((n, batch_size) + col.shape[1:])
+    return out
+
+
+class Trainer:
+    """Base trainer: owns the model spec, loss, worker optimizer, batch
+    size and epoch count (the reference ``Trainer``'s fields), plus the
+    trained result and timing."""
+
+    def __init__(self, model, loss: str = "categorical_crossentropy",
+                 worker_optimizer="sgd", learning_rate: float | None = None,
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+        self.spec = _resolve_spec(model)
+        self.model = self.spec.build()
+        self.loss = loss
+        self.worker_optimizer = worker_optimizer
+        self.learning_rate = learning_rate
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = int(seed)
+        self.training_time: float = 0.0
+        self.history: dict[str, list] = {}
+        self.trained_variables: dict | None = None
+
+    # -- shared plumbing ---------------------------------------------------
+
+    def _tx(self):
+        return resolve_optimizer(self.worker_optimizer, self.learning_rate)
+
+    def _init_variables(self, initial_variables=None) -> dict:
+        if initial_variables is not None:
+            return dict(initial_variables)
+        sample = jnp.asarray(self.spec.example_input(self.batch_size))
+        return self.model.init(jax.random.key(self.seed), sample)
+
+    def _columns(self) -> list[str]:
+        return [self.features_col, self.label_col]
+
+    def _record(self, **kwargs):
+        for k, v in kwargs.items():
+            self.history.setdefault(k, []).append(v)
+
+    def train(self, dataset: Dataset, initial_variables=None) -> dict:
+        start = time.time()
+        try:
+            return self._train(dataset, initial_variables)
+        finally:
+            self.training_time = time.time() - start
+
+    def _train(self, dataset, initial_variables):
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """Sequential baseline: one worker, whole dataset (reference
+    ``SingleTrainer``: coalesce to one partition, SURVEY.md §3.1).  The
+    epoch is scanned on-device in chunks, not stepped from Python."""
+
+    SCAN_CHUNK = 64  # batches per device call (host loop granularity)
+
+    def _train(self, dataset, initial_variables):
+        tx = self._tx()
+        variables = self._init_variables(initial_variables)
+        state = TrainState.create(variables, tx,
+                                  jax.random.key(self.seed + 1))
+        step = make_train_step(self.model, self.loss, tx,
+                               self.features_col, self.label_col)
+        run_chunk = jax.jit(make_window_runner(step))
+
+        for epoch in range(self.num_epoch):
+            shard = dataset.shuffle(seed=self.seed + epoch)
+            stacked = _stack_batches(shard, self.batch_size,
+                                     self._columns())
+            if stacked is None:
+                raise ValueError("dataset smaller than one batch")
+            n = len(next(iter(stacked.values())))
+            losses = []
+            for lo in range(0, n, self.SCAN_CHUNK):
+                chunk = {k: jnp.asarray(v[lo:lo + self.SCAN_CHUNK])
+                         for k, v in stacked.items()}
+                state, metrics = run_chunk(state, chunk)
+                losses.append(np.asarray(metrics["loss"]))
+            epoch_loss = float(np.concatenate(losses).mean())
+            self._record(epoch_loss=epoch_loss)
+        self.trained_variables = state.variables()
+        return self.trained_variables
+
+
+class SyncTrainer(Trainer):
+    """Synchronous data parallelism over the mesh — one jitted step with
+    the global batch sharded across the worker axis; XLA inserts the ICI
+    all-reduce on the gradients (SURVEY.md §2.3 "sync DP via pjit is the
+    natural TPU baseline").  Not in the reference; it is the convergence
+    control arm for the async family."""
+
+    SCAN_CHUNK = 32
+
+    def __init__(self, model, num_workers: int | None = None, **kwargs):
+        super().__init__(model, **kwargs)
+        self.num_workers = num_workers
+
+    def _train(self, dataset, initial_variables):
+        devices = jax.devices()
+        num_workers = self.num_workers or len(devices)
+        use_mesh = len(devices) >= num_workers > 1
+        global_batch = self.batch_size * num_workers
+
+        tx = self._tx()
+        variables = self._init_variables(initial_variables)
+        state = TrainState.create(variables, tx,
+                                  jax.random.key(self.seed + 1))
+        step = make_train_step(self.model, self.loss, tx,
+                               self.features_col, self.label_col)
+        run_chunk = make_window_runner(step)
+
+        if use_mesh:
+            m = mesh_lib.create_mesh(num_workers, devices=devices)
+            rep = NamedSharding(m, P())
+            batch_sharded = NamedSharding(
+                m, P(None, mesh_lib.WORKER_AXIS))  # [chunk, B_global, ...]
+            state = jax.device_put(state, rep)
+            run_chunk = jax.jit(
+                run_chunk,
+                in_shardings=(rep, batch_sharded),
+                out_shardings=(rep, rep))
+        else:
+            run_chunk = jax.jit(run_chunk)
+
+        self.num_workers = num_workers
+        for epoch in range(self.num_epoch):
+            shard = dataset.shuffle(seed=self.seed + epoch)
+            stacked = _stack_batches(shard, global_batch, self._columns())
+            if stacked is None:
+                raise ValueError(
+                    f"dataset smaller than one global batch "
+                    f"({global_batch})")
+            n = len(next(iter(stacked.values())))
+            losses = []
+            for lo in range(0, n, self.SCAN_CHUNK):
+                chunk = {k: jnp.asarray(v[lo:lo + self.SCAN_CHUNK])
+                         for k, v in stacked.items()}
+                state, metrics = run_chunk(state, chunk)
+                losses.append(np.asarray(metrics["loss"]))
+            self._record(epoch_loss=float(np.concatenate(losses).mean()))
+        self.trained_variables = state.variables()
+        return self.trained_variables
+
+
+class DistributedTrainer(Trainer):
+    """Base for the async PS family (reference ``DistributedTrainer`` /
+    ``AsynchronousDistributedTrainer``): ``num_workers`` +
+    ``communication_window``, worker placement on the mesh, emulated
+    commit rounds."""
+
+    def __init__(self, model, num_workers: int = 2,
+                 communication_window: int = 5,
+                 fidelity: str = "faithful", **kwargs):
+        super().__init__(model, **kwargs)
+        self.num_workers = int(num_workers)
+        self.communication_window = int(communication_window)
+        self.fidelity = fidelity
+
+    def allocate_rule(self) -> UpdateRule:
+        raise NotImplementedError
+
+    def _train(self, dataset, initial_variables):
+        rule = self.allocate_rule()
+        tx = self._tx()
+        variables = self._init_variables(initial_variables)
+        center = variables["params"]
+        model_state = {k: v for k, v in variables.items()
+                       if k != "params"}
+        num_workers = self.num_workers
+        window = self.communication_window
+
+        # Per-worker states: identical start, distinct rng streams.
+        def make_worker(rng):
+            return TrainState.create(
+                {"params": center, **model_state}, tx, rng)
+
+        worker_states = jax.vmap(make_worker)(
+            jax.random.split(jax.random.key(self.seed + 1), num_workers))
+
+        step = make_train_step(self.model, self.loss, tx,
+                               self.features_col, self.label_col)
+        round_fn = make_round_fn(rule, step, self.fidelity)
+        ps_state = rule.init_state(center)
+
+        placement = mesh_lib.place_workers(num_workers)
+        if placement.mesh is not None:
+            m = placement.mesh
+            rep = NamedSharding(m, P())
+            row = NamedSharding(m, P(mesh_lib.WORKER_AXIS))
+            worker_states = jax.device_put(worker_states, row)
+            ps_state = jax.device_put(ps_state, rep)
+            round_jit = jax.jit(
+                round_fn,
+                in_shardings=(rep, row, row, rep),
+                out_shardings=(rep, row, rep))
+        else:
+            round_jit = jax.jit(round_fn)
+
+        perm_key = jax.random.key(self.seed + 2)
+        rows_per_worker_batch = self.batch_size
+        cols = self._columns()
+
+        for epoch in range(self.num_epoch):
+            shard_all = dataset.shuffle(seed=self.seed + 17 * epoch)
+            shards = shard_all.repartition(num_workers)
+            per_worker = [
+                _stack_batches(s, rows_per_worker_batch, cols)
+                for s in shards]
+            if any(p is None for p in per_worker):
+                raise ValueError("a worker shard is smaller than one batch")
+            n_batches = min(len(next(iter(p.values())))
+                            for p in per_worker)
+            n_rounds = n_batches // window
+            if n_rounds == 0:
+                raise ValueError(
+                    f"not enough batches per worker ({n_batches}) for one "
+                    f"communication window ({window})")
+            epoch_losses = []
+            for r in range(n_rounds):
+                perm_key, sub = jax.random.split(perm_key)
+                perm = jax.random.permutation(sub, num_workers)
+                # [W, window, B, ...] — slice this round only, so peak
+                # host memory stays at one round's footprint.
+                batch = {
+                    k: jnp.asarray(np.stack(
+                        [p[k][r * window:(r + 1) * window]
+                         for p in per_worker]))
+                    for k in cols}
+                ps_state, worker_states, metrics = round_jit(
+                    ps_state, worker_states, batch, perm)
+                round_loss = float(np.mean(metrics["loss"]))
+                epoch_losses.append(round_loss)
+                self._record(
+                    round_loss=round_loss,
+                    staleness=np.asarray(metrics["staleness"]).tolist())
+            self._record(epoch_loss=float(np.mean(epoch_losses)))
+
+        final_model_state = jax.tree_util.tree_map(
+            lambda x: x[0], worker_states.model_state)
+        self.trained_variables = {"params": ps_state.center,
+                                  **final_model_state}
+        self.parameter_server_state = jax.device_get(ps_state)
+        return self.trained_variables
+
+
+class DOWNPOUR(DistributedTrainer):
+    """Dean et al. async SGD (reference ``DOWNPOUR``)."""
+
+    def allocate_rule(self):
+        return DownpourRule()
+
+
+class ADAG(DistributedTrainer):
+    """Asynchronous Distributed Adaptive Gradients — window-normalized
+    deltas (reference's flagship, ``ADAG``)."""
+
+    def allocate_rule(self):
+        return AdagRule()
+
+
+class DynSGD(DistributedTrainer):
+    """Staleness-scaled commits (reference ``DynSGD``)."""
+
+    def allocate_rule(self):
+        return DynSGDRule()
+
+
+class AEASGD(DistributedTrainer):
+    """Asynchronous Elastic Averaging SGD (Zhang et al.; reference
+    ``AEASGD``).  ``alpha = learning_rate * rho`` as in the paper's
+    stability condition."""
+
+    def __init__(self, model, rho: float = 5.0, **kwargs):
+        kwargs.setdefault("learning_rate", 0.01)
+        super().__init__(model, **kwargs)
+        self.rho = float(rho)
+
+    @property
+    def alpha(self) -> float:
+        return float(self.learning_rate) * self.rho
+
+    def allocate_rule(self):
+        return ElasticRule(alpha=self.alpha)
+
+
+class EAMSGD(AEASGD):
+    """AEASGD with Nesterov momentum in the worker loop (reference
+    ``EAMSGD`` — same server law, momentum on the worker)."""
+
+    def __init__(self, model, momentum: float = 0.9, **kwargs):
+        kwargs.setdefault("worker_optimizer", "nesterov")
+        super().__init__(model, **kwargs)
+        self.momentum = momentum
+
+    def _tx(self):
+        if self.worker_optimizer == "nesterov":
+            return resolve_optimizer("nesterov",
+                                     self.learning_rate,
+                                     m=self.momentum)
+        return super()._tx()
+
+
+class EnsembleTrainer(Trainer):
+    """Train ``num_models`` independent replicas (different seeds / data
+    shards); returns the list of variable dicts (reference
+    ``EnsembleTrainer``, SURVEY.md §2.3 [LOW])."""
+
+    def __init__(self, model, num_models: int = 2, **kwargs):
+        super().__init__(model, **kwargs)
+        self.num_models = int(num_models)
+
+    def _train(self, dataset, initial_variables):
+        results = []
+        shards = dataset.repartition(self.num_models)
+        for i, shard in enumerate(shards):
+            sub = SingleTrainer(
+                self.spec, loss=self.loss,
+                worker_optimizer=self.worker_optimizer,
+                learning_rate=self.learning_rate,
+                features_col=self.features_col, label_col=self.label_col,
+                batch_size=self.batch_size, num_epoch=self.num_epoch,
+                seed=self.seed + i)
+            results.append(sub.train(shard, initial_variables))
+            self._record(epoch_loss=sub.history["epoch_loss"][-1])
+        self.trained_variables = results[0]
+        self.ensemble_variables = results
+        return results
+
+
+class AveragingTrainer(Trainer):
+    """Train workers independently on shards, average their parameters
+    (reference ``AveragingTrainer``, SURVEY.md §2.3 [LOW])."""
+
+    def __init__(self, model, num_workers: int = 2, **kwargs):
+        super().__init__(model, **kwargs)
+        self.num_workers = int(num_workers)
+
+    def _train(self, dataset, initial_variables):
+        trained = []
+        for i, shard in enumerate(dataset.repartition(self.num_workers)):
+            sub = SingleTrainer(
+                self.spec, loss=self.loss,
+                worker_optimizer=self.worker_optimizer,
+                learning_rate=self.learning_rate,
+                features_col=self.features_col, label_col=self.label_col,
+                batch_size=self.batch_size, num_epoch=self.num_epoch,
+                seed=self.seed)  # same init across workers
+            trained.append(sub.train(shard, initial_variables))
+            self._record(epoch_loss=sub.history["epoch_loss"][-1])
+        avg = trained[0]["params"]
+        for t in trained[1:]:
+            avg = tree_add(avg, t["params"])
+        avg = tree_scale(avg, 1.0 / self.num_workers)
+        rest = {k: v for k, v in trained[0].items() if k != "params"}
+        self.trained_variables = {"params": avg, **rest}
+        return self.trained_variables

@@ -1,0 +1,163 @@
+"""Worker machinery: the on-chip training loop.
+
+TPU-native redesign of the reference's ``distkeras/workers.py`` (SURVEY.md
+§3.2): where the reference's worker is a Python closure shipped into a
+Spark task that calls ``model.train_on_batch`` and crosses the Python ↔
+backend boundary *every step*, the rebuild's worker is a jitted
+``train_step`` scanned over a window of batches — the whole communication
+window executes on-device in one XLA program (the hot-loop fix called out
+in SURVEY.md §3.2 observations).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from distkeras_tpu.ops.losses import resolve_loss
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Optimizers, resolvable by Keras-style names (reference workers compile the
+# model with a `worker_optimizer` string — SURVEY.md §2.1 Worker base).
+# ---------------------------------------------------------------------------
+
+OPTIMIZERS: dict[str, Callable[..., optax.GradientTransformation]] = {
+    "sgd": lambda lr=0.01, **kw: optax.sgd(lr, **kw),
+    "momentum": lambda lr=0.01, m=0.9, **kw: optax.sgd(lr, momentum=m, **kw),
+    "nesterov": lambda lr=0.01, m=0.9, **kw: optax.sgd(
+        lr, momentum=m, nesterov=True, **kw),
+    "adam": lambda lr=0.001, **kw: optax.adam(lr, **kw),
+    "adagrad": lambda lr=0.01, **kw: optax.adagrad(lr, **kw),
+    "rmsprop": lambda lr=0.001, **kw: optax.rmsprop(lr, **kw),
+    "adamw": lambda lr=0.001, **kw: optax.adamw(lr, **kw),
+}
+
+
+def resolve_optimizer(optimizer, learning_rate: float | None = None,
+                      **kwargs) -> optax.GradientTransformation:
+    """String name / optax transform -> optax transform."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if isinstance(optimizer, str):
+        if optimizer not in OPTIMIZERS:
+            raise KeyError(f"unknown optimizer {optimizer!r}; known: "
+                           f"{sorted(OPTIMIZERS)}")
+        if learning_rate is not None:
+            kwargs["lr"] = learning_rate
+        return OPTIMIZERS[optimizer](**kwargs)
+    raise TypeError(f"cannot resolve optimizer from {type(optimizer)}")
+
+
+# ---------------------------------------------------------------------------
+# Train state.
+# ---------------------------------------------------------------------------
+
+
+class TrainState(struct.PyTreeNode):
+    """Per-worker training state.
+
+    ``model_state`` carries non-parameter collections (e.g. BatchNorm
+    ``batch_stats``); it stays worker-local under the PS trainers —
+    parameter-server rules exchange ``params`` only (SURVEY.md §7 L1).
+    """
+
+    step: jnp.ndarray
+    params: Pytree
+    opt_state: Pytree
+    model_state: Mapping[str, Pytree]
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, variables: Mapping[str, Pytree],
+               tx: optax.GradientTransformation,
+               rng: jax.Array) -> "TrainState":
+        params = variables["params"]
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), model_state=model_state,
+                   rng=rng)
+
+    def variables(self) -> dict[str, Pytree]:
+        return {"params": self.params, **self.model_state}
+
+
+# ---------------------------------------------------------------------------
+# Jitted step + window runner.
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(model, loss, tx: optax.GradientTransformation,
+                    features_col: str = "features",
+                    label_col: str = "label"):
+    """Build ``step(state, batch) -> (state, metrics)``.
+
+    Handles dropout rngs and mutable collections (batch_stats) generically;
+    pure and jittable, so it can be ``vmap``-ed per worker and ``scan``-ed
+    over a communication window.
+    """
+    loss_fn = resolve_loss(loss)
+
+    def step(state: TrainState, batch: Mapping[str, jnp.ndarray]):
+        x, y = batch[features_col], batch[label_col]
+        rng = jax.random.fold_in(state.rng, state.step)
+        mutable_keys = list(state.model_state)
+
+        def objective(params):
+            variables = {"params": params, **state.model_state}
+            if mutable_keys:
+                logits, new_model_state = model.apply(
+                    variables, x, train=True, rngs={"dropout": rng},
+                    mutable=mutable_keys)
+            else:
+                logits = model.apply(variables, x, train=True,
+                                     rngs={"dropout": rng})
+                new_model_state = state.model_state
+            return loss_fn(logits, y), new_model_state
+
+        (loss_val, new_model_state), grads = jax.value_and_grad(
+            objective, has_aux=True)(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  opt_state=new_opt_state,
+                                  model_state=dict(new_model_state))
+        metrics = {"loss": loss_val,
+                   "grad_norm": optax.global_norm(grads)}
+        return new_state, metrics
+
+    return step
+
+
+def make_window_runner(step_fn):
+    """``run(state, batches) -> (state, metrics)``: lax.scan ``step_fn``
+    over a stacked window of batches (leaves ``[window, B, ...]``).  This
+    is the reference's per-window inner loop compiled into one XLA program.
+    """
+
+    def run(state: TrainState, batches: Mapping[str, jnp.ndarray]):
+        return jax.lax.scan(step_fn, state, batches)
+
+    return run
+
+
+def make_eval_step(model, loss, features_col: str = "features",
+                   label_col: str = "label"):
+    """Build ``eval_step(variables, batch) -> metrics`` (no mutation)."""
+    loss_fn = resolve_loss(loss)
+
+    @functools.partial(jax.jit, static_argnums=())
+    def eval_step(variables, batch):
+        logits = model.apply(variables, batch[features_col], train=False)
+        return {"loss": loss_fn(logits, batch[label_col]),
+                "logits": logits}
+
+    return eval_step

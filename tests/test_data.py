@@ -1,0 +1,171 @@
+"""Data layer: Dataset verbs, transformer semantics (the reference's
+transformers.py surface), synthetic generators."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import (
+    Dataset,
+    DenseTransformer,
+    HashBucketTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    Pipeline,
+    ReshapeTransformer,
+    StandardScaleTransformer,
+    datasets,
+)
+
+
+def _ds():
+    return Dataset({"x": np.arange(12, dtype=np.float32),
+                    "y": np.arange(12) % 3})
+
+
+class TestDataset:
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError):
+            Dataset({"a": np.arange(3), "b": np.arange(4)})
+
+    def test_verbs(self):
+        ds = _ds()
+        assert len(ds) == 12
+        assert ds.select(["x"]).column_names == ["x"]
+        ds2 = ds.with_column("z", ds["x"] * 2)
+        np.testing.assert_allclose(ds2["z"], ds["x"] * 2)
+        assert "z" not in ds  # immutability
+        assert len(ds.filter(ds["y"] == 0)) == 4
+        assert ds.rename({"x": "w"}).column_names[0] == "w"
+        assert len(ds.take(5)) == 5
+        assert len(ds.concat(ds)) == 24
+        assert ds.drop("y").column_names == ["x"]
+
+    def test_shuffle_alignment(self):
+        ds = Dataset({"x": np.arange(100),
+                      "y": np.arange(100) * 3}).shuffle(seed=7)
+        np.testing.assert_array_equal(ds["y"], ds["x"] * 3)
+        assert not np.array_equal(ds["x"], np.arange(100))
+
+    def test_shard_and_repartition(self):
+        ds = _ds()
+        shards = ds.repartition(3)
+        assert [len(s) for s in shards] == [4, 4, 4]
+        back = np.concatenate([s["x"] for s in shards])
+        np.testing.assert_array_equal(back, ds["x"])
+        with pytest.raises(ValueError):
+            ds.shard(3, 5)
+        with pytest.raises(ValueError):
+            Dataset({"x": np.arange(2)}).shard(3, 0)
+
+    def test_batches(self):
+        ds = _ds()
+        bs = list(ds.batches(5))
+        assert len(bs) == 2 and len(bs[0]["x"]) == 5
+        assert ds.num_batches(5) == 2
+        assert ds.num_batches(5, drop_remainder=False) == 3
+
+
+class TestTransformers:
+    def test_label_index(self):
+        ds = Dataset({"label": np.array(["b", "a", "b", "c"])})
+        t = LabelIndexTransformer("label").fit(ds)
+        out = t.transform(ds)
+        np.testing.assert_array_equal(out["label_index"], [1, 0, 1, 2])
+        unseen = Dataset({"label": np.array(["z"])})
+        with pytest.raises(ValueError, match="unseen"):
+            t.transform(unseen)
+
+    def test_one_hot(self):
+        ds = Dataset({"y": np.array([0, 2, 1])})
+        out = OneHotTransformer("y", 3).transform(ds)
+        np.testing.assert_allclose(out["y_onehot"],
+                                   np.eye(3)[[0, 2, 1]])
+        with pytest.raises(ValueError):
+            OneHotTransformer("y", 2).transform(ds)
+
+    def test_min_max(self):
+        ds = Dataset({"f": np.array([[0., 10.], [5., 20.]])})
+        out = MinMaxTransformer("f").fit_transform(ds)
+        np.testing.assert_allclose(out["f"], [[0, 0], [1, 1]])
+        # constant column doesn't divide by zero
+        const = Dataset({"f": np.ones((4, 2))})
+        np.testing.assert_allclose(
+            MinMaxTransformer("f").fit_transform(const)["f"], 0.0)
+
+    def test_standard_scale(self):
+        ds = Dataset({"f": np.random.default_rng(0).normal(
+            5.0, 3.0, size=(1000, 4))})
+        out = StandardScaleTransformer("f").fit_transform(ds)
+        assert abs(out["f"].mean()) < 0.01
+        assert abs(out["f"].std() - 1.0) < 0.01
+
+    def test_reshape(self):
+        ds = Dataset({"f": np.arange(24, dtype=np.float32).reshape(2, 12)})
+        out = ReshapeTransformer("f", (3, 4)).transform(ds)
+        assert out["f"].shape == (2, 3, 4)
+
+    def test_dense(self):
+        ds = Dataset({"idx": np.array([[0, 3], [1, -1]]),
+                      "val": np.array([[1., 2.], [5., 9.]])})
+        out = DenseTransformer("idx", "val", dim=4).transform(ds)
+        np.testing.assert_allclose(out["features"],
+                                   [[1, 0, 0, 2], [0, 5, 0, 0]])
+
+    def test_hash_bucket_deterministic(self):
+        ds = Dataset({"c": np.array(["a", "b", "a"])})
+        out = HashBucketTransformer("c", 16).transform(ds)
+        assert out["c_bucket"][0] == out["c_bucket"][2]
+        out2 = HashBucketTransformer("c", 16).transform(ds)
+        np.testing.assert_array_equal(out["c_bucket"], out2["c_bucket"])
+        assert out["c_bucket"].max() < 16
+
+    def test_pipeline(self):
+        ds = Dataset({"label": np.array(["x", "y", "x", "y"]),
+                      "f": np.array([[1.], [2.], [3.], [4.]])})
+        pipe = Pipeline([
+            LabelIndexTransformer("label"),
+            MinMaxTransformer("f"),
+            OneHotTransformer("label_index", 2),
+        ])
+        out = pipe.fit(ds).transform(ds)
+        assert out["label_index_onehot"].shape == (4, 2)
+        np.testing.assert_allclose(out["f"].ravel(),
+                                   [0, 1 / 3, 2 / 3, 1], atol=1e-6)
+
+    def test_unfitted_raises(self):
+        ds = Dataset({"f": np.ones((2, 2))})
+        with pytest.raises(RuntimeError):
+            MinMaxTransformer("f").transform(ds)
+
+
+class TestSyntheticDatasets:
+    def test_shapes(self):
+        assert datasets.mnist_synth(64)["features"].shape == (64, 28, 28, 1)
+        assert datasets.cifar10_synth(32)["features"].shape == (32, 32, 32, 3)
+        imdb = datasets.imdb_synth(16, seq_len=32)
+        assert imdb["features"].shape == (16, 32)
+        criteo = datasets.criteo_synth(32, num_dense=5, num_categorical=3)
+        assert criteo["dense"].shape == (32, 5)
+        assert "c2" in criteo
+        lm = datasets.lm_synth(8, seq_len=16, vocab_size=64)
+        assert lm["features"].shape == lm["label"].shape == (8, 16)
+        # next-token structure: label is features shifted by one
+        np.testing.assert_array_equal(lm["features"][:, 1:],
+                                      lm["label"][:, :-1])
+
+    def test_labels_learnable_and_balanced(self):
+        ds = datasets.synthetic_classification(2000, (8,), 4, seed=0)
+        counts = np.bincount(ds["label"], minlength=4)
+        assert counts.min() > 100  # no collapsed class
+        # deterministic given seed
+        ds2 = datasets.synthetic_classification(2000, (8,), 4, seed=0)
+        np.testing.assert_array_equal(ds["label"], ds2["label"])
+
+
+def test_hash_bucket_vectorized_matches_scalar():
+    values = np.array(["", "a", "cat_123", "日本語", "x" * 40])
+    t = HashBucketTransformer("c", 1 << 20)
+    vec = t._fnv1a_vectorized(values)
+    for v, h in zip(values, vec):
+        assert int(h) == t._fnv1a(str(v).encode("utf-8")), v

@@ -110,3 +110,14 @@ def test_attention_rejects_indivisible_heads():
                           num_heads=2, max_len=8, dtype="float32")
     with pytest.raises(ValueError, match="divisible"):
         model.init(jax.random.key(0), jnp.ones((1, 4), jnp.int32))
+
+
+def test_config_json_roundtrip_preserves_tuples():
+    from distkeras_tpu.utils import (deserialize_model_config,
+                                     serialize_model_config)
+    cfg = CONFIGS["mlp"]
+    wire = deserialize_model_config(serialize_model_config(cfg))
+    m1 = build_model(cfg)
+    m2 = ModelSpec.from_config(wire).build()
+    assert m1 == m2
+    hash(m2)  # usable as a static jit argument

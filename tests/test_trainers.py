@@ -1,0 +1,135 @@
+"""Trainer family: convergence smoke tests on learnable synthetic data,
+faithful-vs-fast fidelity equivalence, staleness telemetry, mesh placement
+(SURVEY.md §4: the rebuild's analogue of the reference's MNIST-notebook
+integration tests, run on the 8-virtual-device CPU mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import model_config
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    SyncTrainer,
+)
+
+MLP = model_config("mlp", (8,), num_classes=4, hidden=(32,))
+DATA = datasets.synthetic_classification(2048, (8,), 4, seed=0)
+
+
+def _first_last(history_key, trainer):
+    h = trainer.history[history_key]
+    return h[0], h[-1]
+
+
+def test_single_trainer_converges():
+    t = SingleTrainer(MLP, worker_optimizer="adam", learning_rate=3e-3,
+                      batch_size=64, num_epoch=3)
+    variables = t.train(DATA)
+    first, last = _first_last("epoch_loss", t)
+    assert last < first * 0.7, t.history
+    assert t.training_time > 0
+    assert "params" in variables
+
+
+def test_sync_trainer_uses_mesh_and_converges(devices):
+    t = SyncTrainer(MLP, num_workers=8, worker_optimizer="adam",
+                    learning_rate=3e-3, batch_size=16, num_epoch=3)
+    t.train(DATA)
+    first, last = _first_last("epoch_loss", t)
+    assert last < first * 0.7, t.history
+    assert t.num_workers == 8
+
+
+@pytest.mark.parametrize("cls", [DOWNPOUR, ADAG, DynSGD, AEASGD, EAMSGD])
+@pytest.mark.parametrize("fidelity", ["faithful", "fast"])
+def test_async_family_converges(cls, fidelity):
+    kwargs = dict(num_workers=4, communication_window=4, batch_size=32,
+                  num_epoch=3, learning_rate=0.05, fidelity=fidelity)
+    if cls in (AEASGD, EAMSGD):
+        kwargs["rho"] = 5.0
+        kwargs["learning_rate"] = 0.02
+    t = cls(MLP, **kwargs)
+    t.train(DATA)
+    losses = t.history["round_loss"]
+    assert losses[-1] < losses[0] * 0.8, (cls.__name__, losses[:3],
+                                          losses[-3:])
+    # staleness telemetry: every round records a permutation of 0..W-1
+    stal = np.asarray(t.history["staleness"])
+    assert stal.shape[1] == 4
+    assert np.array_equal(np.sort(stal[0]), np.arange(4))
+
+
+def test_faithful_and_fast_center_match_for_linear_rules():
+    """One round of DOWNPOUR: the fast path's center must equal the
+    faithful path's exactly (the sum of deltas is order-free)."""
+    results = {}
+    for fidelity in ("faithful", "fast"):
+        t = DOWNPOUR(MLP, num_workers=4, communication_window=2,
+                     batch_size=32, num_epoch=1, learning_rate=0.05,
+                     fidelity=fidelity, seed=3)
+        # limit to exactly one round of data
+        sub = DATA.take(4 * 2 * 32)
+        t.train(sub)
+        results[fidelity] = jax.device_get(
+            t.trained_variables["params"])
+    flat_a = jax.tree_util.tree_leaves(results["faithful"])
+    flat_b = jax.tree_util.tree_leaves(results["fast"])
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_dynsgd_staleness_scaling_changes_result():
+    """DynSGD must differ from DOWNPOUR on identical data/seed (staleness
+    scaling is real)."""
+    common = dict(num_workers=4, communication_window=2, batch_size=32,
+                  num_epoch=1, learning_rate=0.05, seed=0)
+    a = DOWNPOUR(MLP, **common)
+    b = DynSGD(MLP, **common)
+    a.train(DATA.take(1024))
+    b.train(DATA.take(1024))
+    la = jax.tree_util.tree_leaves(a.trained_variables["params"])
+    lb = jax.tree_util.tree_leaves(b.trained_variables["params"])
+    assert any(not np.allclose(x, y) for x, y in zip(la, lb))
+
+
+def test_ensemble_trainer_returns_list():
+    t = EnsembleTrainer(MLP, num_models=2, worker_optimizer="adam",
+                        learning_rate=3e-3, batch_size=32, num_epoch=1)
+    models = t.train(DATA)
+    assert isinstance(models, list) and len(models) == 2
+    la = jax.tree_util.tree_leaves(models[0]["params"])
+    lb = jax.tree_util.tree_leaves(models[1]["params"])
+    assert any(not np.allclose(x, y) for x, y in zip(la, lb))
+
+
+def test_averaging_trainer_averages():
+    t = AveragingTrainer(MLP, num_workers=2, worker_optimizer="adam",
+                         learning_rate=3e-3, batch_size=32, num_epoch=1)
+    variables = t.train(DATA)
+    assert "params" in variables
+
+
+def test_async_trainer_with_dropout_model():
+    """Dropout rngs flow per worker (distinct streams)."""
+    cfg = model_config("mlp", (8,), num_classes=4, hidden=(32,),
+                       dropout_rate=0.3)
+    t = ADAG(cfg, num_workers=2, communication_window=2, batch_size=32,
+             num_epoch=1, learning_rate=0.05)
+    t.train(DATA.take(512))
+    assert len(t.history["round_loss"]) >= 1
+
+
+def test_errors_on_tiny_dataset():
+    t = ADAG(MLP, num_workers=4, communication_window=8, batch_size=64,
+             num_epoch=1)
+    with pytest.raises(ValueError):
+        t.train(DATA.take(128))
