@@ -1,13 +1,14 @@
-"""Test harness: 8 virtual CPU devices (the reference's ``local[N]`` mode).
+"""Test harness: 8 virtual CPU devices (the reference's ``local[N]`` mode,
+SURVEY.md §4).
 
-Must set flags before jax initializes (SURVEY.md §4: multi-device CPU mesh
-via ``--xla_force_host_platform_device_count`` is the Spark ``local[N]``
-analogue).
+The container's sitecustomize pins the platform list to the real TPU
+(``axon``) at interpreter startup and ignores later env-var changes, so
+the reliable override is ``jax.config.update`` after import — plus
+``XLA_FLAGS`` set in-process before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,9 +16,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices():
-    return jax.devices()
+    devs = jax.devices()
+    assert len(devs) == 8 and devs[0].platform == "cpu", devs
+    return devs
