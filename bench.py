@@ -2,45 +2,36 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+``vs_baseline``/``mfu`` are null when the device kind has no known peak
+(fabricating a peak would fabricate the metric — ADVICE.md r1).
 
 The reference published no machine-readable numbers (BASELINE.md:
 "published: {}"), so ``vs_baseline`` is measured MFU against the north-star
 target of 0.60 MFU from BASELINE.json (vs_baseline = MFU / 0.60).
 
-FLOPs are taken from XLA's own cost analysis of the compiled step (not a
-hand formula), so MFU accounting is honest for whatever model/config runs.
+MFU accounting (see PERF.md): ``mfu`` uses the *analytic model FLOPs* —
+2 x MACs x 3 for a training step (ResNet-50 fwd = 4.09 GMACs = 8.18
+GFLOPs/image at 224px) — NOT XLA's executed-FLOPs counter.  The two agree
+within ~3% at batch <= 512 (so the number is also *measured*-honest), but
+XLA's counter inflates when the compiler adds rematerialization (at batch
+1024 it reports ~30% more FLOPs while images/sec drops), which would let a
+slower configuration "win".  Model FLOPs per image is the denominator that
+tracks useful work.  Both numbers are reported.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets).
-PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "cpu": 1e12,  # nominal, for CI runs off-TPU
-}
-
-
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
-    for key, val in PEAK_FLOPS.items():
-        if kind.lower().startswith(key.lower()):
-            return val
-    return 100e12
+from distkeras_tpu.profiling import (
+    peak_flops,
+    resnet50_model_flops,
+    time_step_chain,
+)
 
 
 def main():
@@ -50,7 +41,7 @@ def main():
 
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
-    batch = 128 if on_tpu else 4
+    batch = 256 if on_tpu else 4
     image = 224 if on_tpu else 64
     num_classes = 1000 if on_tpu else 10
 
@@ -65,40 +56,33 @@ def main():
     batch_dict = {"features": x, "label": labels}
 
     jit_step = jax.jit(step, donate_argnums=0)
-    lowered = jit_step.lower(state, batch_dict)
-    compiled = lowered.compile()
+    compiled = jit_step.lower(state, batch_dict).compile()
     cost = compiled.cost_analysis()
-    flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
+    xla_flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
 
-    # Warmup, then timed steps.  NOTE: sync via a scalar fetch of the
-    # final step's loss — on the tunneled TPU platform block_until_ready
-    # can return before execution finishes, but a host transfer cannot
-    # (the loss depends on the whole step chain).
-    state, metrics = jit_step(state, batch_dict)
-    state, metrics = jit_step(state, batch_dict)
-    float(metrics["loss"])
-    n_steps = 30 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = jit_step(state, batch_dict)
-    float(metrics["loss"])
-    dt = (time.perf_counter() - t0) / n_steps
+    dt, synced = time_step_chain(jit_step, state, batch_dict,
+                                 n=30 if on_tpu else 3)
 
     images_per_sec = batch / dt
-    mfu = (flops_per_step / dt) / peak_flops(device) \
-        if flops_per_step else 0.0
+    model_flops_per_step = resnet50_model_flops(batch, image)
+    peak, peak_known = peak_flops(device)
+    mfu = model_flops_per_step / dt / peak if peak_known else None
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(mfu / 0.60, 4),
-        "mfu": round(mfu, 4),
+        "vs_baseline": round(mfu / 0.60, 4) if peak_known else None,
+        "mfu": round(mfu, 4) if peak_known else None,
+        "xla_mfu": (round(xla_flops_per_step / dt / peak, 4)
+                    if peak_known else None),
         "step_time_ms": round(dt * 1e3, 2),
         "batch": batch,
         "image": image,
-        "flops_per_step": flops_per_step,
+        "model_flops_per_step": model_flops_per_step,
+        "xla_flops_per_step": xla_flops_per_step,
         "device": getattr(device, "device_kind", str(device)),
-        "loss_finite": bool(np.isfinite(float(metrics["loss"]))),
+        "peak_flops_known": peak_known,
+        "metrics_finite": bool(np.isfinite(synced)),
     }))
 
 

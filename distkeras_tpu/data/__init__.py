@@ -9,6 +9,7 @@ vectorized, static-shape, host-side — feeding device-sharded batches
 
 from distkeras_tpu.data.dataset import Dataset  # noqa: F401
 from distkeras_tpu.data.transformers import (  # noqa: F401
+    AssembleTransformer,
     DenseTransformer,
     HashBucketTransformer,
     LabelIndexTransformer,

@@ -18,15 +18,24 @@ def _rng(seed: int) -> np.random.Generator:
 
 
 def synthetic_classification(num_rows: int, feature_shape: tuple[int, ...],
-                             num_classes: int, seed: int = 0) -> Dataset:
-    """Gaussian features; label = argmax of a fixed random linear map (a
-    learnable, well-conditioned signal)."""
+                             num_classes: int, seed: int = 0,
+                             margin: float = 1.0) -> Dataset:
+    """Gaussian mixture with one center per class: ``x = center[label] +
+    noise``, center coordinates ~ N(0, margin²), unit noise.
+
+    The per-coordinate class signal is ~``margin * sqrt(2)`` noise stds —
+    deliberately NOT normalized by dimension, so gradient descent sees
+    strong signal in every coordinate and smoke-test budgets converge at
+    any feature size.  (Both earlier generators — argmax-of-linear-map and
+    dim-normalized centers — had large aggregate but vanishing
+    per-coordinate signal at 784 dims: feature learning stalled on the
+    uniform-loss plateau for hundreds of epochs.)"""
     rng = _rng(seed)
-    x = rng.normal(size=(num_rows, *feature_shape)).astype(np.float32)
-    flat = x.reshape(num_rows, -1)
-    w = _rng(seed + 1).normal(size=(flat.shape[1], num_classes))
-    w /= np.sqrt(flat.shape[1])
-    label = np.argmax(flat @ w, axis=1).astype(np.int32)
+    dim = int(np.prod(feature_shape))
+    label = rng.integers(0, num_classes, size=num_rows).astype(np.int32)
+    centers = _rng(seed + 1).normal(size=(num_classes, dim)) * margin
+    x = rng.normal(size=(num_rows, dim)) + centers[label]
+    x = x.astype(np.float32).reshape(num_rows, *feature_shape)
     return Dataset({"features": x, "label": label})
 
 

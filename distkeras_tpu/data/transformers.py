@@ -236,6 +236,27 @@ class HashBucketTransformer(Transformer):
         return dataset.with_column(self.output_col, out)
 
 
+class AssembleTransformer(Transformer):
+    """Concatenate numeric columns into one float32 feature matrix — the
+    Spark ``VectorAssembler`` idiom the reference notebooks use to build
+    ``features_col`` before training.  Scalar columns contribute one
+    column each; matrix columns are flattened per row."""
+
+    def __init__(self, input_cols: Sequence[str],
+                 output_col: str = "features"):
+        self.input_cols = list(input_cols)
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        parts = []
+        n = len(dataset)
+        for name in self.input_cols:
+            col = np.asarray(dataset[name], dtype=np.float32)
+            parts.append(col.reshape(n, -1))
+        return dataset.with_column(self.output_col,
+                                   np.concatenate(parts, axis=1))
+
+
 class Pipeline(Transformer):
     """Sequential transformer composition (fit stages in order, each on
     the output of the previous)."""

@@ -19,7 +19,10 @@ from distkeras_tpu.predictors import ModelPredictor
 class AccuracyEvaluator:
     """Classification accuracy from a prediction column.
 
-    Accepts class-id predictions (int) or logits/probabilities (argmax'd).
+    Accepts class-id predictions (int) or logits/probabilities (argmax'd),
+    and integer or one-hot label columns (the reference's OneHotTransformer
+    workflow produces one-hot labels — mirrored from the one-hot support
+    in ops/losses.py).
     """
 
     def __init__(self, prediction_col: str = "prediction",
@@ -32,6 +35,17 @@ class AccuracyEvaluator:
         if pred.ndim > 1:
             pred = np.argmax(pred, axis=-1)
         labels = np.asarray(dataset[self.label_col])
+        if labels.ndim > pred.ndim:
+            # a trailing axis of width 1 is a column vector of class ids,
+            # not a one-hot encoding — argmaxing it would zero every label
+            if labels.shape[-1] > 1:
+                labels = np.argmax(labels, axis=-1)
+            else:
+                labels = np.squeeze(labels, axis=-1)
+        if labels.shape != pred.shape:
+            raise ValueError(
+                f"prediction shape {pred.shape} and label shape "
+                f"{labels.shape} do not align")
         return float(np.mean(pred == labels))
 
 

@@ -38,12 +38,24 @@ class AdaptiveGroupNorm(nn.Module):
                             scale_init=self.scale_init)(x)
 
 
+class _Identity(nn.Module):
+    """No-op norm (perf ablation / fully-stateless configs)."""
+
+    scale_init: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        return x
+
+
 def _norm(norm: str, dtype, train: bool) -> Callable:
     if norm == "batch":
         return functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=0.9, epsilon=1e-5, dtype=dtype)
     if norm == "group":
         return functools.partial(AdaptiveGroupNorm, dtype=dtype)
+    if norm == "none":
+        return _Identity
     raise ValueError(f"unknown norm {norm!r}")
 
 

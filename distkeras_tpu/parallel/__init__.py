@@ -7,4 +7,5 @@ from distkeras_tpu.parallel.update_rules import (  # noqa: F401
     PSState,
     UpdateRule,
     apply_commit_round,
+    apply_commit_round_pulls,
 )

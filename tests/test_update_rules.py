@@ -11,6 +11,7 @@ from distkeras_tpu.parallel import (
     DynSGDRule,
     ElasticRule,
     apply_commit_round,
+    apply_commit_round_pulls,
 )
 
 
@@ -85,6 +86,44 @@ def test_commit_round_matches_sequential_loop():
     np.testing.assert_allclose(np.asarray(post["w"]), np.stack(posts),
                                rtol=1e-6)
     assert int(final.clock) == n
+
+
+def test_commit_round_pulls_matches_stacked_path_delta_rule():
+    """In-scan pulls (O(params) path) == stacked pre/post path + pull law,
+    for a delta-family rule (pull ignores local: pulled_i = post_i)."""
+    rule = DynSGDRule()
+    st0 = rule.init_state(_params(0.0))
+    payloads = {
+        "w": jnp.stack([jnp.full((3,), float(i + 1)) for i in range(5)]),
+        "b": jnp.stack([jnp.full((2, 2), float(i + 1)) for i in range(5)]),
+    }
+    final_a, _, post = apply_commit_round(rule, st0, payloads)
+    final_b, pulled = apply_commit_round_pulls(rule, st0, payloads, None)
+    np.testing.assert_allclose(_leaf(final_a.center),
+                               _leaf(final_b.center), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pulled["w"]),
+                               np.asarray(post["w"]), rtol=1e-6)
+    assert int(final_b.clock) == 5
+
+
+def test_commit_round_pulls_matches_stacked_path_elastic_rule():
+    """Elastic rule: pulled_i = lerp(local_i, pre_i) — the in-scan path
+    must reproduce the stacked path's per-position pulls exactly."""
+    rule = ElasticRule(alpha=0.25)
+    st0 = rule.init_state(_params(0.0))
+    n = 4
+    payloads = {"w": jnp.arange(1.0, n + 1)[:, None] * jnp.ones((n, 3)),
+                "b": jnp.arange(1.0, n + 1)[:, None, None]
+                * jnp.ones((n, 2, 2))}
+    locals_ = payloads  # elastic payload IS the local params
+    final_a, pre, post = apply_commit_round(rule, st0, payloads)
+    expect = jax.vmap(rule.worker_pull)(locals_, pre, post)
+    final_b, pulled = apply_commit_round_pulls(rule, st0, payloads,
+                                               locals_)
+    np.testing.assert_allclose(_leaf(final_a.center),
+                               _leaf(final_b.center), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pulled["w"]),
+                               np.asarray(expect["w"]), rtol=1e-6)
 
 
 def test_commit_round_is_jittable():
