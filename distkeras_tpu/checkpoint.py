@@ -1,0 +1,93 @@
+"""Checkpoint / resume (SURVEY.md §5: the reference has NONE — the
+trained model lives in the PS thread's memory and dies with the driver).
+
+Format: one msgpack file (flax canonical encoding) holding the training
+pytrees plus a JSON-encoded cursor (epoch / round / step).  Typed PRNG
+keys are packed to their raw uint32 data on save and re-wrapped on load
+(msgpack cannot carry extended dtypes).  Writes are atomic
+(tmp + rename), so a checkpoint is never observed half-written.
+
+Trainers integrate via ``Trainer(..., checkpoint_dir=...)`` to save at
+every epoch boundary (and optionally every N commit rounds), and
+``train(..., resume_from=...)`` to continue a killed run; the resumed
+run reproduces the uninterrupted one bit-for-bit because every source of
+randomness (data shuffle, commit permutations, dropout rngs) is keyed by
+saved state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+from flax import serialization as flax_serialization
+
+Pytree = Any
+
+LATEST = "ckpt_latest.msgpack"
+
+
+def _is_key(x) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key)
+
+
+def pack_prng_keys(tree: Pytree) -> Pytree:
+    """Typed PRNG key leaves -> raw uint32 key data (serializable)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree)
+
+
+def unpack_prng_keys(template: Pytree, tree: Pytree) -> Pytree:
+    """Re-wrap raw key data wherever ``template`` holds a typed key."""
+    return jax.tree_util.tree_map(
+        lambda t, x: jax.random.wrap_key_data(jnp.asarray(x))
+        if _is_key(t) else x, template, tree)
+
+
+def save_checkpoint(path: str | os.PathLike, state: Pytree,
+                    cursor: Mapping[str, Any]) -> str:
+    """Atomically write ``{state, cursor}``; returns the file path.
+
+    ``path`` may be a directory — created if needed, file named
+    ``ckpt_latest.msgpack`` — or an explicit file path (anything with a
+    suffix, e.g. ``model.ckpt``, is treated as a file).
+    """
+    path = pathlib.Path(path)
+    if not path.suffix:
+        path.mkdir(parents=True, exist_ok=True)
+        path = path / LATEST
+    payload = {
+        "state": pack_prng_keys(jax.device_get(state)),
+        "cursor": json.dumps(dict(cursor)),
+    }
+    data = flax_serialization.to_bytes(payload)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+    return str(path)
+
+
+def load_checkpoint(path: str | os.PathLike, state_template: Pytree
+                    ) -> tuple[Pytree, dict]:
+    """Read a checkpoint written by ``save_checkpoint``.
+
+    ``state_template`` must be a pytree of the same structure/shapes as
+    the saved state (trainers construct it for free by building their
+    initial states before resuming).  Returns ``(state, cursor)``.
+    """
+    path = pathlib.Path(path)
+    if path.is_dir():
+        path = path / LATEST
+    template = {
+        "state": pack_prng_keys(state_template),
+        "cursor": "",
+    }
+    payload = flax_serialization.from_bytes(template,
+                                            path.read_bytes())
+    state = unpack_prng_keys(state_template, payload["state"])
+    return state, json.loads(payload["cursor"])

@@ -26,34 +26,85 @@ ModuleDef = Any
 
 class AdaptiveGroupNorm(nn.Module):
     """GroupNorm with group count adapted to the channel width (gcd with 32)
-    so narrow stems/test widths still divide evenly."""
+    so narrow stems/test widths still divide evenly.
+
+    ``impl`` selects the lowering: ``'flax'`` (default) is
+    ``nn.GroupNorm``, which XLA fuses well (PERF.md §4 measured it
+    fastest end-to-end); ``'pallas'`` is the hand-written single-pass
+    kernel in ``ops/pallas_kernels.py`` — measured SLOWER on v5e (the
+    opaque custom call breaks XLA's conv↔norm fusion and half-fills the
+    lanes at the 64-channel stem), kept as a tested opt-in for future
+    tuning.  ``relu=True`` fuses the following activation.  NOTE: the
+    two impls produce different parameter-tree nesting; pick one per
+    model lifetime.
+    """
 
     dtype: Any = jnp.float32
     scale_init: Any = nn.initializers.ones_init()
+    relu: bool = False
+    impl: str = "flax"  # 'flax' | 'pallas'
 
     @nn.compact
     def __call__(self, x):
-        groups = math.gcd(32, x.shape[-1])
-        return nn.GroupNorm(num_groups=groups, dtype=self.dtype,
-                            scale_init=self.scale_init)(x)
+        channels = x.shape[-1]
+        groups = math.gcd(32, channels)
+        if self.impl == "pallas":
+            from distkeras_tpu.ops.pallas_kernels import fused_group_norm
+
+            gamma = self.param("scale", self.scale_init, (channels,),
+                               jnp.float32)
+            beta = self.param("bias", nn.initializers.zeros_init(),
+                              (channels,), jnp.float32)
+            return fused_group_norm(x, gamma, beta, groups=groups,
+                                    relu=self.relu)
+        y = nn.GroupNorm(num_groups=groups, dtype=self.dtype,
+                         scale_init=self.scale_init)(x)
+        return nn.relu(y) if self.relu else y
 
 
 class _Identity(nn.Module):
     """No-op norm (perf ablation / fully-stateless configs)."""
 
     scale_init: Any = None
+    relu: bool = False
 
     @nn.compact
     def __call__(self, x):
-        return x
+        return nn.relu(x) if self.relu else x
+
+
+class _BatchNormRelu(nn.Module):
+    """BatchNorm with the same (scale_init, relu) factory surface as
+    AdaptiveGroupNorm so block code is norm-flavor-agnostic.
+
+    NOTE: wrapping nests the variable paths one level deeper than a bare
+    ``nn.BatchNorm`` (``.../_BatchNormRelu_0/BatchNorm_0/...``) —
+    variables exported from a pre-wrapper ``norm='batch'`` model do not
+    load into post-wrapper models."""
+
+    dtype: Any
+    use_running_average: bool
+    scale_init: Any = nn.initializers.ones_init()
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.BatchNorm(use_running_average=self.use_running_average,
+                         momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                         scale_init=self.scale_init)(x)
+        return nn.relu(y) if self.relu else y
 
 
 def _norm(norm: str, dtype, train: bool) -> Callable:
     if norm == "batch":
-        return functools.partial(nn.BatchNorm, use_running_average=not train,
-                                 momentum=0.9, epsilon=1e-5, dtype=dtype)
+        return functools.partial(_BatchNormRelu,
+                                 dtype=dtype,
+                                 use_running_average=not train)
     if norm == "group":
         return functools.partial(AdaptiveGroupNorm, dtype=dtype)
+    if norm == "group_pallas":
+        return functools.partial(AdaptiveGroupNorm, dtype=dtype,
+                                 impl="pallas")
     if norm == "none":
         return _Identity
     raise ValueError(f"unknown norm {norm!r}")
@@ -70,8 +121,7 @@ class BasicBlock(nn.Module):
         residual = x
         y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
                     use_bias=False, dtype=self.dtype)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(relu=True)(y)
         y = nn.Conv(self.filters, (3, 3), padding="SAME",
                     use_bias=False, dtype=self.dtype)(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
@@ -93,12 +143,10 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(relu=True)(y)
         y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
                     use_bias=False, dtype=self.dtype)(y)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self.norm(relu=True)(y)
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
                     dtype=self.dtype)(y)
         # zero-init the last norm's scale so blocks start as identity
@@ -131,8 +179,7 @@ class ResNet(nn.Module):
         x = x.astype(dtype)
         x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=dtype)(x)
-        x = norm()(x)
-        x = nn.relu(x)
+        x = norm(relu=True)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, size in enumerate(self.stage_sizes):
             for i in range(size):

@@ -87,7 +87,8 @@ class Trainer:
     def __init__(self, model, loss: str = "categorical_crossentropy",
                  worker_optimizer="sgd", learning_rate: float | None = None,
                  features_col: str = "features", label_col: str = "label",
-                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0):
+                 batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
+                 checkpoint_dir: str | None = None):
         self.spec = _resolve_spec(model)
         self.model = self.spec.build()
         self.loss = loss
@@ -98,6 +99,7 @@ class Trainer:
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
+        self.checkpoint_dir = checkpoint_dir
         self.training_time: float = 0.0
         self.history: dict[str, list] = {}
         self.trained_variables: dict | None = None
@@ -120,15 +122,45 @@ class Trainer:
         for k, v in kwargs.items():
             self.history.setdefault(k, []).append(v)
 
-    def train(self, dataset: Dataset, initial_variables=None) -> dict:
+    def train(self, dataset: Dataset, initial_variables=None,
+              resume_from: str | None = None) -> dict:
+        """Train on ``dataset``.  ``resume_from`` continues from a
+        checkpoint written by a previous run with ``checkpoint_dir``
+        set (same trainer configuration + dataset ⇒ bitwise-identical
+        continuation; see distkeras_tpu.checkpoint)."""
         start = time.time()
         try:
-            return self._train(dataset, initial_variables)
+            return self._train(dataset, initial_variables, resume_from)
         finally:
             self.training_time = time.time() - start
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
         raise NotImplementedError
+
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def _maybe_save(self, state, cursor: dict):
+        # The full history rides in every checkpoint so a resumed run
+        # reproduces the uninterrupted history exactly.  Cost grows with
+        # rounds trained (O(rounds) per save); for very long runs with
+        # frequent mid-epoch saves, an append-only side log would be
+        # cheaper — revisit if save latency ever shows up in profiles.
+        if self.checkpoint_dir is not None:
+            from distkeras_tpu import checkpoint as ckpt
+
+            ckpt.save_checkpoint(self.checkpoint_dir, state,
+                                 {**cursor, "history": self.history})
+
+    def _maybe_resume(self, resume_from, state_template):
+        """Returns (state, cursor) — (template, {}) when not resuming."""
+        if resume_from is None:
+            return state_template, {}
+        from distkeras_tpu import checkpoint as ckpt
+
+        state, cursor = ckpt.load_checkpoint(resume_from, state_template)
+        self.history = {k: list(v)
+                        for k, v in cursor.pop("history", {}).items()}
+        return state, cursor
 
 
 class SingleTrainer(Trainer):
@@ -138,16 +170,18 @@ class SingleTrainer(Trainer):
 
     SCAN_CHUNK = 64  # batches per device call (host loop granularity)
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
         tx = self._tx()
         variables = self._init_variables(initial_variables)
         state = TrainState.create(variables, tx,
                                   jax.random.key(self.seed + 1))
+        state, cursor = self._maybe_resume(resume_from, state)
+        start_epoch = int(cursor.get("epoch", 0))
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
         run_chunk = jax.jit(make_window_runner(step))
 
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             shard = dataset.shuffle(seed=self.seed + epoch)
             stacked = _stack_batches(shard, self.batch_size,
                                      self._columns())
@@ -162,6 +196,7 @@ class SingleTrainer(Trainer):
                 losses.append(np.asarray(metrics["loss"]))
             epoch_loss = float(np.concatenate(losses).mean())
             self._record(epoch_loss=epoch_loss)
+            self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
         return self.trained_variables
 
@@ -179,7 +214,7 @@ class SyncTrainer(Trainer):
         super().__init__(model, **kwargs)
         self.num_workers = num_workers
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
         devices = jax.devices()
         num_workers = self.num_workers or len(devices)
         use_mesh = len(devices) >= num_workers > 1
@@ -189,6 +224,8 @@ class SyncTrainer(Trainer):
         variables = self._init_variables(initial_variables)
         state = TrainState.create(variables, tx,
                                   jax.random.key(self.seed + 1))
+        state, cursor = self._maybe_resume(resume_from, state)
+        start_epoch = int(cursor.get("epoch", 0))
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
         run_chunk = make_window_runner(step)
@@ -207,7 +244,7 @@ class SyncTrainer(Trainer):
             run_chunk = jax.jit(run_chunk)
 
         self.num_workers = num_workers
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             shard = dataset.shuffle(seed=self.seed + epoch)
             stacked = _stack_batches(shard, global_batch, self._columns())
             if stacked is None:
@@ -222,6 +259,7 @@ class SyncTrainer(Trainer):
                 state, metrics = run_chunk(state, chunk)
                 losses.append(np.asarray(metrics["loss"]))
             self._record(epoch_loss=float(np.concatenate(losses).mean()))
+            self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
         return self.trained_variables
 
@@ -234,16 +272,18 @@ class DistributedTrainer(Trainer):
 
     def __init__(self, model, num_workers: int = 2,
                  communication_window: int = 5,
-                 fidelity: str = "faithful", **kwargs):
+                 fidelity: str = "faithful",
+                 checkpoint_every_rounds: int | None = None, **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.fidelity = fidelity
+        self.checkpoint_every_rounds = checkpoint_every_rounds
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
         rule = self.allocate_rule()
         tx = self._tx()
         variables = self._init_variables(initial_variables)
@@ -265,6 +305,16 @@ class DistributedTrainer(Trainer):
                                self.features_col, self.label_col)
         round_fn = make_round_fn(rule, step, self.fidelity)
         ps_state = rule.init_state(center)
+        perm_key = jax.random.key(self.seed + 2)
+
+        ckpt_state, cursor = self._maybe_resume(
+            resume_from, {"ps": ps_state, "workers": worker_states,
+                          "perm_key": perm_key})
+        ps_state, worker_states, perm_key = (
+            ckpt_state["ps"], ckpt_state["workers"],
+            ckpt_state["perm_key"])
+        start_epoch = int(cursor.get("epoch", 0))
+        start_round = int(cursor.get("round", 0))
 
         placement = mesh_lib.place_workers(num_workers)
         if placement.mesh is not None:
@@ -280,11 +330,10 @@ class DistributedTrainer(Trainer):
         else:
             round_jit = jax.jit(round_fn)
 
-        perm_key = jax.random.key(self.seed + 2)
         rows_per_worker_batch = self.batch_size
         cols = self._columns()
 
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             shard_all = dataset.shuffle(seed=self.seed + 17 * epoch)
             shards = shard_all.repartition(num_workers)
             per_worker = [
@@ -299,13 +348,24 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     f"not enough batches per worker ({n_batches}) for one "
                     f"communication window ({window})")
-            # Tail batches that don't fill a whole window are dropped
-            # (the reference's per-partition loop had the same remainder
-            # behavior); record the count so it is never silent.
-            self._record(
-                dropped_tail_batches=n_batches - n_rounds * window)
-            epoch_losses = []
-            for r in range(n_rounds):
+            resuming_mid_epoch = epoch == start_epoch and start_round > 0
+            if resuming_mid_epoch:
+                # this epoch's pre-kill rounds live in the restored
+                # history: seed epoch_losses with them (so epoch_loss
+                # matches the uninterrupted run) and don't re-record
+                # dropped_tail_batches for the same epoch
+                epoch_losses = list(
+                    self.history.get("round_loss", [])[-start_round:])
+            else:
+                # Tail batches that don't fill a whole window are
+                # dropped (the reference's per-partition loop had the
+                # same remainder behavior); record the count so it is
+                # never silent.
+                self._record(
+                    dropped_tail_batches=n_batches - n_rounds * window)
+                epoch_losses = []
+            first_round = start_round if epoch == start_epoch else 0
+            for r in range(first_round, n_rounds):
                 perm_key, sub = jax.random.split(perm_key)
                 perm = jax.random.permutation(sub, num_workers)
                 # [W, window, B, ...] device batch for this round; note
@@ -324,7 +384,17 @@ class DistributedTrainer(Trainer):
                 self._record(
                     round_loss=round_loss,
                     staleness=np.asarray(metrics["staleness"]).tolist())
+                every = self.checkpoint_every_rounds
+                if every and (r + 1) % every == 0 and r + 1 < n_rounds:
+                    self._maybe_save(
+                        {"ps": ps_state, "workers": worker_states,
+                         "perm_key": perm_key},
+                        {"epoch": epoch, "round": r + 1})
             self._record(epoch_loss=float(np.mean(epoch_losses)))
+            self._maybe_save(
+                {"ps": ps_state, "workers": worker_states,
+                 "perm_key": perm_key},
+                {"epoch": epoch + 1, "round": 0})
 
         final_model_state = jax.tree_util.tree_map(
             lambda x: x[0], worker_states.model_state)
@@ -400,7 +470,11 @@ class EnsembleTrainer(Trainer):
         super().__init__(model, **kwargs)
         self.num_models = int(num_models)
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
+        if resume_from is not None or self.checkpoint_dir is not None:
+            raise ValueError(
+                "EnsembleTrainer does not support checkpointing; "
+                "checkpoint the member SingleTrainers instead")
         results = []
         shards = dataset.repartition(self.num_models)
         for i, shard in enumerate(shards):
@@ -426,7 +500,11 @@ class AveragingTrainer(Trainer):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
 
-    def _train(self, dataset, initial_variables):
+    def _train(self, dataset, initial_variables, resume_from=None):
+        if resume_from is not None or self.checkpoint_dir is not None:
+            raise ValueError(
+                "AveragingTrainer does not support checkpointing; "
+                "checkpoint the member SingleTrainers instead")
         trained = []
         for i, shard in enumerate(dataset.repartition(self.num_workers)):
             sub = SingleTrainer(

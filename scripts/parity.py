@@ -1,0 +1,141 @@
+"""Async-vs-sync convergence parity — BASELINE.md's primary metric.
+
+Trains the same model on the same dataset with the same per-worker batch
+size and epoch budget through the synchronous control arm (SyncTrainer)
+and each async PS trainer (ADAG / AEASGD / DynSGD / DOWNPOUR), then
+writes the loss curves + final-accuracy table to ``parity.json`` and
+``PARITY.md``.  This is the evidence that the on-mesh emulated-staleness
+design (ps_emulator, SURVEY.md §7 design 5b) matches the sync arm's
+convergence — the research core of the rebuild.
+
+Runs on a forced 8-virtual-device CPU mesh so results are reproducible
+anywhere:  python scripts/parity.py [--workers 8] [--epochs 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+
+# Force the virtual CPU mesh before jax initializes (the reference's
+# local[N] analogue; see tests/conftest.py for why config-after-import).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run(trainer_name: str, cls, cfg, data, kwargs, eval_data):
+    from distkeras_tpu.evaluators import evaluate_model
+
+    t = cls(cfg, **kwargs)
+    t.train(data)
+    metrics = evaluate_model(t.model, t.trained_variables, eval_data,
+                             batch_size=512)
+    curve = t.history.get("round_loss") or t.history.get("epoch_loss")
+    return {
+        "trainer": trainer_name,
+        "final_loss": float(curve[-1]),
+        "accuracy": metrics["accuracy"],
+        "training_time_s": round(t.training_time, 2),
+        "epoch_loss": [round(x, 4) for x in t.history["epoch_loss"]],
+        "loss_curve": [round(x, 4) for x in curve],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import (ADAG, AEASGD, DOWNPOUR, DynSGD,
+                                        SyncTrainer)
+
+    import numpy as np
+
+    cfg = model_config("mlp", (16,), num_classes=8, hidden=(64,))
+    # train/eval are a split of ONE mixture (same class centers —
+    # a different seed would draw different centers, i.e. a different
+    # task, and eval accuracy would sit at chance).
+    n_eval = 2048
+    full = datasets.synthetic_classification(args.rows + n_eval, (16,),
+                                             8, seed=0)
+    idx = np.arange(len(full))
+    data = full.filter(idx < args.rows)
+    eval_data = full.filter(idx >= args.rows)
+
+    common = dict(batch_size=args.batch, num_epoch=args.epochs,
+                  learning_rate=0.05, seed=0)
+    async_kwargs = dict(num_workers=args.workers,
+                        communication_window=args.window, **common)
+
+    results = [run("SyncTrainer", SyncTrainer, cfg, data,
+                   dict(num_workers=args.workers, **common), eval_data)]
+    for name, cls, extra in [
+        ("ADAG", ADAG, {}),
+        ("DynSGD", DynSGD, {}),
+        ("DOWNPOUR", DOWNPOUR, {}),
+        ("AEASGD", AEASGD, {"rho": 2.5, "learning_rate": 0.02}),
+    ]:
+        kw = {**async_kwargs, **extra}
+        results.append(run(name, cls, cfg, data, kw, eval_data))
+
+    sync_acc = results[0]["accuracy"]
+    for r in results[1:]:
+        r["accuracy_gap_vs_sync"] = round(r["accuracy"] - sync_acc, 4)
+
+    payload = {
+        "config": vars(args),
+        "model": cfg,
+        "note": ("identical dataset/epochs/per-worker batch; staleness "
+                 "emulated on-mesh with per-round permuted commit order "
+                 "(ps_emulator 'faithful' default)"),
+        "results": results,
+    }
+    (REPO / "parity.json").write_text(json.dumps(payload, indent=2))
+
+    lines = [
+        "# PARITY — async PS trainers vs the synchronous control arm",
+        "",
+        "BASELINE.md primary metric: \"async-vs-sync convergence curves\".",
+        f"Setup: MLP (16,)->8, {args.rows} rows, {args.workers} workers, "
+        f"batch {args.batch}/worker, window {args.window}, "
+        f"{args.epochs} epochs, 8-virtual-device CPU mesh.  Full curves "
+        "in `parity.json`.",
+        "",
+        "| Trainer | final loss | eval accuracy | gap vs sync | time (s) |",
+        "|---|---|---|---|---|",
+    ]
+    for r in results:
+        gap = r.get("accuracy_gap_vs_sync", "—")
+        lines.append(
+            f"| {r['trainer']} | {r['final_loss']:.4f} | "
+            f"{r['accuracy']:.4f} | {gap} | {r['training_time_s']} |")
+    lines += [
+        "",
+        "Interpretation: the async family must land within a few points "
+        "of the sync arm's accuracy on the same budget; DynSGD's "
+        "staleness scaling and ADAG's window normalization should show "
+        "no degradation at this staleness level (max staleness = "
+        f"{args.workers - 1} commits/round).",
+    ]
+    (REPO / "PARITY.md").write_text("\n".join(lines) + "\n")
+    print(json.dumps({r["trainer"]: r["accuracy"] for r in results},
+                     indent=2))
+
+
+if __name__ == "__main__":
+    main()

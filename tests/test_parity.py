@@ -1,0 +1,33 @@
+"""Async-vs-sync convergence parity (BASELINE.md primary metric) at CI
+scale: the emulated-staleness async trainers must match the synchronous
+control arm's held-out accuracy on an identical data/epoch budget."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import datasets
+from distkeras_tpu.evaluators import evaluate_model
+from distkeras_tpu.models import model_config
+from distkeras_tpu.trainers import ADAG, DynSGD, SyncTrainer
+
+CFG = model_config("mlp", (16,), num_classes=8, hidden=(32,))
+_FULL = datasets.synthetic_classification(3072, (16,), 8, seed=0)
+_IDX = np.arange(len(_FULL))
+TRAIN = _FULL.filter(_IDX < 2048)
+EVAL = _FULL.filter(_IDX >= 2048)
+
+
+def _accuracy(trainer) -> float:
+    trainer.train(TRAIN)
+    return evaluate_model(trainer.model, trainer.trained_variables,
+                          EVAL, batch_size=512)["accuracy"]
+
+
+@pytest.mark.parametrize("cls", [ADAG, DynSGD])
+def test_async_matches_sync_on_same_budget(cls):
+    common = dict(batch_size=32, num_epoch=3, learning_rate=0.05, seed=0)
+    sync_acc = _accuracy(SyncTrainer(CFG, num_workers=4, **common))
+    async_acc = _accuracy(cls(CFG, num_workers=4,
+                              communication_window=2, **common))
+    assert sync_acc > 0.7, sync_acc  # the control arm itself must learn
+    assert async_acc > sync_acc - 0.10, (sync_acc, async_acc)
