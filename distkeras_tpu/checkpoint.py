@@ -54,11 +54,15 @@ def save_checkpoint(path: str | os.PathLike, state: Pytree,
     """Atomically write ``{state, cursor}``; returns the file path.
 
     ``path`` may be a directory — created if needed, file named
-    ``ckpt_latest.msgpack`` — or an explicit file path (anything with a
-    suffix, e.g. ``model.ckpt``, is treated as a file).
+    ``ckpt_latest.msgpack`` — or an explicit ``.msgpack``/``.ckpt`` file
+    path.  Any other path (including dotted directory names like
+    ``runs/v1.5``) is treated as a directory, matching
+    ``load_checkpoint``'s ``is_dir`` check once it exists.
     """
     path = pathlib.Path(path)
-    if not path.suffix:
+    if path.suffix in (".msgpack", ".ckpt") and not path.is_dir():
+        path.parent.mkdir(parents=True, exist_ok=True)
+    else:
         path.mkdir(parents=True, exist_ok=True)
         path = path / LATEST
     payload = {

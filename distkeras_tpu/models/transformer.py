@@ -3,8 +3,9 @@
 The reference has no long-sequence story (SURVEY.md §5: "long-context /
 sequence parallelism: absent"); the TPU rebuild makes it first-class.  The
 attention op is pluggable: dense causal attention on a single device, or
-ring attention over a ``seq`` mesh axis (``distkeras_tpu.parallel.
-ring_attention``) when the trainer shards the sequence dimension.
+ring attention over a mesh axis (``distkeras_tpu.parallel.ring_attention``)
+when ``seq_axis`` is set and the caller shards the time dimension
+(``parallel.ring_attention.sequence_sharded_apply``).
 """
 
 from __future__ import annotations
@@ -71,6 +72,14 @@ class Block(nn.Module):
 
 @register_model("transformer_lm")
 class TransformerLM(nn.Module):
+    """``seq_axis``: name of a mesh axis the *time* dimension is sharded
+    over.  When set, the module is an SPMD program to be applied inside
+    ``jax.shard_map`` (see ``parallel.ring_attention.sequence_sharded_
+    apply``): positions are offset by the device's ring index and
+    attention defaults to ``ring_attention`` over that axis.  Every other
+    sublayer is position-wise, so nothing else changes — the same
+    parameters run dense or sequence-parallel."""
+
     vocab_size: int = 32000
     num_layers: int = 4
     d_model: int = 256
@@ -78,23 +87,38 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: str = "bfloat16"
-    attn_fn: Optional[AttnFn] = None  # None -> dense causal
+    attn_fn: Optional[AttnFn] = None  # None -> dense causal / ring
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
+        import jax.lax as lax
+
         dtype = jnp.dtype(self.dtype)
         tokens = tokens.astype(jnp.int32)
         t = tokens.shape[1]
-        if t > self.max_len:
+        attn_fn = self.attn_fn
+        if self.seq_axis is not None:
+            from distkeras_tpu.parallel.ring_attention import ring_attn_fn
+
+            t_global = t * lax.axis_size(self.seq_axis)
+            positions = (lax.axis_index(self.seq_axis) * t
+                         + jnp.arange(t))[None, :]
+            if attn_fn is None:
+                attn_fn = ring_attn_fn(self.seq_axis)
+        else:
+            t_global = t
+            positions = jnp.arange(t)[None, :]
+        if t_global > self.max_len:
             raise ValueError(
-                f"sequence length {t} exceeds max_len={self.max_len}")
+                f"sequence length {t_global} exceeds "
+                f"max_len={self.max_len}")
         x = nn.Embed(self.vocab_size, self.d_model, dtype=dtype)(tokens)
         pos = nn.Embed(self.max_len, self.d_model, dtype=dtype,
-                       name="pos_embed")(jnp.arange(t)[None, :])
+                       name="pos_embed")(positions)
         x = x + pos
         for _ in range(self.num_layers):
-            x = Block(self.num_heads, self.mlp_ratio, dtype,
-                      self.attn_fn)(x)
+            x = Block(self.num_heads, self.mlp_ratio, dtype, attn_fn)(x)
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)

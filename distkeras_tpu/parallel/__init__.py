@@ -1,3 +1,8 @@
+from distkeras_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attn_fn,
+    sequence_sharded_apply,
+)
 from distkeras_tpu.parallel.update_rules import (  # noqa: F401
     RULES,
     AdagRule,

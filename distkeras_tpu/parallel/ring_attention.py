@@ -1,0 +1,128 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+The reference has no long-context story at all (SURVEY.md §5 "long-context
+/ sequence parallelism: absent" — its longest-sequence workload, the IMDB
+BiLSTM, handles sequences whole per worker).  The TPU rebuild makes
+sequence parallelism first-class: shard the time axis of ``q``/``k``/``v``
+across a mesh axis, keep the query block resident, and rotate the
+key/value blocks around the ring with ``lax.ppermute`` — one hop per
+step, N-1 hops total — accumulating exact softmax attention with the
+online (flash-style) running max / denominator.  The ICI traffic per
+step is one K/V block, which overlaps with the block's matmuls on TPU.
+
+Memory: the forward pass holds O(T_local) activations per device and
+never materializes a [T_local, T_global] attention matrix.  The backward
+pass is autodiff through the scan with a rematerialized body: scan
+stores only the per-step carries (the rotating K/V blocks and f32
+accumulators) and recomputes each block's logits/probabilities in the
+backward sweep, so training memory is linear in sequence length, not
+quadratic.  (A custom reverse-ring VJP that re-rotates K/V instead of
+storing per-step carries would cut the stored-carry term from
+O(T_global) to O(T_local) per device; future work.)
+
+This is an SPMD op: call it inside ``jax.shard_map`` (or use
+``ring_attn_fn`` as the ``attn_fn`` of a ``TransformerLM`` whose
+``seq_axis`` names the mesh axis).  Differentiable (the backward pass is
+autodiff through ``ppermute``, i.e. the reverse ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e30)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, scale: float | None = None,
+                   causal: bool = True) -> jax.Array:
+    """Exact (flash-accumulated) attention over a ring of devices.
+
+    Args:
+      q, k, v: local sequence blocks ``[B, T_local, H, D]`` — the global
+        time axis is sharded over ``axis_name`` in mesh order, so device
+        ``i`` holds global positions ``[i*T_local, (i+1)*T_local)``.
+      axis_name: the mesh axis the sequence is sharded over.
+      scale: logit scale; defaults to ``D ** -0.5``.
+      causal: apply a causal mask in *global* positions.
+
+    Returns:
+      Attention output ``[B, T_local, H, D]`` in ``q.dtype`` (accumulation
+      is always f32).
+    """
+    orig_dtype = q.dtype
+    q32 = q.astype(jnp.float32)
+    b, t_local, h, d = q32.shape
+    if scale is None:
+        scale = d ** -0.5
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    q_pos = me * t_local + jnp.arange(t_local)
+
+    # Each step the K/V blocks hop one device backward, so at step s this
+    # device sees the block originally on device (me + s) % n.
+    ring = [(i, (i - 1) % n) for i in range(n)]
+
+    def body(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        src = (me + s) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            p = p * mask[None, None]  # exact zeros for masked entries
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        # Rotate (the hop after the last step restores the original
+        # placement, which keeps the scan carry shape uniform).
+        k_blk = lax.ppermute(k_blk, axis_name, ring)
+        v_blk = lax.ppermute(v_blk, axis_name, ring)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    # pvary: the accumulators are device-varying (they depend on this
+    # device's q block), which scan's carry typing must see from step 0.
+    init = (k, v, *map(
+        lambda x: lax.pcast(x, (axis_name,), to="varying"),
+        (jnp.full((b, h, t_local), _NEG, jnp.float32),
+         jnp.zeros((b, h, t_local), jnp.float32),
+         jnp.zeros((b, h, t_local, d), jnp.float32))))
+    (_, _, _, l, acc), _ = lax.scan(jax.checkpoint(body), init,
+                                    jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(orig_dtype)
+
+
+def ring_attn_fn(axis_name: str, causal: bool = True):
+    """An ``AttnFn`` (``TransformerLM.attn_fn`` signature) bound to a
+    mesh axis: ``fn(q, k, v, *, scale)``."""
+    return functools.partial(ring_attention, axis_name=axis_name,
+                             causal=causal)
+
+
+def sequence_sharded_apply(fn, mesh, seq_axis: str, *,
+                           num_seq_args: int = 1):
+    """Wrap ``fn(params, *arrays)`` in a ``shard_map`` that shards axis 1
+    (time) of each array argument over ``seq_axis`` and replicates
+    ``params`` — the standard harness for running a ``seq_axis``-enabled
+    model (e.g. ``TransformerLM(seq_axis=...)``) sequence-parallel.
+
+    ``num_seq_args`` array arguments follow ``params``; outputs are
+    returned sequence-sharded (time axis 1).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    seq_spec = P(None, seq_axis)
+    in_specs = (P(),) + (seq_spec,) * num_seq_args
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=seq_spec, check_vma=False)

@@ -1,0 +1,119 @@
+"""Shared plumbing for the example scripts.
+
+The reference's user surface was example notebooks running the full
+ETL -> train -> predict -> evaluate pipeline on a local Spark context
+(SURVEY.md §1 L7, §4 "example notebooks as integration tests").  These
+scripts are the rebuild's equivalent: each one is a runnable pipeline for
+one BASELINE.md config, defaulting to small learnable synthetic data
+(zero egress — see distkeras_tpu.data.datasets) and shapes that finish in
+seconds on a laptop CPU or a single TPU chip.
+
+``--devices N`` is the Spark ``local[N]`` analogue: it forces an
+N-device virtual CPU mesh so the distributed trainers exercise real
+mesh sharding + ICI-style collectives without N chips.  It must take
+effect before jax initializes, hence ``parse_args_and_setup`` must be
+called before importing anything that imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+# Make the examples runnable from a source checkout without installation.
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def make_parser(description: str, **defaults) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--rows", type=int,
+                   default=defaults.get("rows", 2048),
+                   help="synthetic dataset rows")
+    p.add_argument("--epochs", type=int,
+                   default=defaults.get("epochs", 3))
+    p.add_argument("--batch-size", type=int,
+                   default=defaults.get("batch_size", 32),
+                   help="per-worker batch size")
+    p.add_argument("--workers", type=int,
+                   default=defaults.get("workers", 4),
+                   help="data-parallel workers (mesh axis size)")
+    p.add_argument("--window", type=int,
+                   default=defaults.get("window", 2),
+                   help="communication window (local steps per commit)")
+    p.add_argument("--learning-rate", type=float,
+                   default=defaults.get("learning_rate", 0.01))
+    p.add_argument("--devices", type=int, default=0, metavar="N",
+                   help="force an N-device virtual CPU mesh (the "
+                        "reference's local[N]; 0 = use real devices)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="write checkpoints here (enables --resume)")
+    p.add_argument("--resume", default=None, metavar="DIR",
+                   help="resume from a checkpoint directory")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def parse_args_and_setup(parser: argparse.ArgumentParser):
+    """Parse args and, if requested, force a virtual CPU mesh.
+
+    Must run before any jax *backend* is initialized (first device use),
+    which holds as long as it is called before distkeras_tpu imports —
+    XLA_FLAGS are read at backend init, and the platform pin is a
+    jax.config update (same recipe as ``__graft_entry__._force_cpu_mesh``;
+    env vars alone are ignored because the container's sitecustomize
+    already imported jax).
+    """
+    args = parser.parse_args()
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        n = len(jax.devices())
+        if n != args.devices:
+            raise RuntimeError(
+                f"--devices {args.devices} requested but the jax backend "
+                f"was already initialized with {n} devices")
+    return args
+
+
+def report(config_name: str, trainer, metrics: dict, **extra) -> None:
+    """Print the run summary: human-readable lines + one JSON line."""
+    print(f"[{config_name}] trained in {trainer.training_time:.2f}s")
+    losses = trainer.history.get("epoch_loss", [])
+    if losses:
+        print(f"[{config_name}] epoch loss: "
+              + " -> ".join(f"{x:.4f}" for x in losses))
+    for k, v in metrics.items():
+        print(f"[{config_name}] {k}: {v:.4f}")
+    summary = {
+        "config": config_name,
+        "training_time_s": round(trainer.training_time, 3),
+        "epoch_loss": [round(float(x), 5) for x in losses],
+        **{k: round(float(v), 5) for k, v in metrics.items()},
+        **extra,
+    }
+    print(json.dumps(summary))
+
+
+def timed(label: str):
+    """Context manager printing wall time of a pipeline stage."""
+
+    class _Timer:
+        def __enter__(self):
+            self.t0 = time.time()
+            return self
+
+        def __exit__(self, *exc):
+            print(f"[{label}] {time.time() - self.t0:.2f}s")
+
+    return _Timer()

@@ -1,0 +1,51 @@
+"""CIFAR-10 ConvNet + ADAG — BASELINE.md row 2.
+
+Pipeline: synthetic CIFAR-shaped data -> ADAG (the reference's flagship
+async trainer) over a worker mesh -> predict -> accuracy, with per-round
+staleness telemetry printed at the end (observability the reference
+lacked, SURVEY.md §5).
+
+Run:  python examples/cifar_convnet_adag.py --devices 8
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+
+def main():
+    parser = make_parser(__doc__, rows=2048, epochs=2, batch_size=16,
+                         workers=4, window=2, learning_rate=0.02)
+    args = parse_args_and_setup(parser)
+
+    import numpy as np
+
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import ADAG
+
+    data = datasets.cifar10_synth(args.rows, seed=args.seed + 1)
+    cfg = model_config("convnet", (32, 32, 3), num_classes=10,
+                       widths=(16, 32), dense=64)
+    trainer = ADAG(cfg, num_workers=args.workers,
+                   communication_window=args.window,
+                   batch_size=args.batch_size, num_epoch=args.epochs,
+                   learning_rate=args.learning_rate,
+                   worker_optimizer="adam", seed=args.seed,
+                   checkpoint_dir=args.checkpoint_dir)
+    variables = trainer.train(data, resume_from=args.resume)
+
+    metrics = evaluate_model(trainer.model, variables, data,
+                             batch_size=256)
+    stal = np.asarray(trainer.history["staleness"])
+    print(f"[cifar_adag] staleness per commit: mean {stal.mean():.2f}, "
+          f"max {stal.max()} over {stal.size} commits")
+    report("cifar_convnet_adag", trainer, metrics,
+           staleness_mean=round(float(stal.mean()), 3))
+
+
+if __name__ == "__main__":
+    main()

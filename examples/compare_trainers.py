@@ -1,0 +1,65 @@
+"""Run every trainer on the same data and compare accuracy + time.
+
+The closest analogue of the reference's MNIST workflow notebook, whose
+punchline was a table of training time and accuracy per trainer
+(SURVEY.md §4 "example notebooks as integration tests", §6 README
+plots).
+
+Run:  python examples/compare_trainers.py --devices 8
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup
+
+
+def main():
+    parser = make_parser(__doc__, rows=4096, epochs=2, batch_size=32,
+                         workers=4, window=2, learning_rate=3e-3)
+    args = parse_args_and_setup(parser)
+
+    from distkeras_tpu import trainers
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+
+    data = datasets.mnist_synth(args.rows, seed=args.seed)
+    cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
+    common = dict(worker_optimizer="adam",
+                  learning_rate=args.learning_rate,
+                  batch_size=args.batch_size, num_epoch=args.epochs,
+                  seed=args.seed)
+    dist = dict(num_workers=args.workers,
+                communication_window=args.window)
+
+    runs = {
+        "single": trainers.SingleTrainer(cfg, **common),
+        "sync": trainers.SyncTrainer(cfg, num_workers=args.workers,
+                                     **common),
+        "downpour": trainers.DOWNPOUR(cfg, **dist, **common),
+        "adag": trainers.ADAG(cfg, **dist, **common),
+        "aeasgd": trainers.AEASGD(cfg, **dist, **common),
+        "eamsgd": trainers.EAMSGD(cfg, **dist, **common),
+        "dynsgd": trainers.DynSGD(cfg, **dist, **common),
+    }
+
+    rows = []
+    for name, trainer in runs.items():
+        variables = trainer.train(data)
+        acc = evaluate_model(trainer.model, variables, data,
+                             batch_size=256)["accuracy"]
+        rows.append({"trainer": name, "accuracy": round(acc, 4),
+                     "time_s": round(trainer.training_time, 2),
+                     "final_loss": round(
+                         float(trainer.history["epoch_loss"][-1]), 4)})
+        print(f"{name:>9}: accuracy {acc:.4f}  "
+              f"time {trainer.training_time:6.2f}s  "
+              f"loss {rows[-1]['final_loss']:.4f}")
+    print(json.dumps({"config": "compare_trainers", "runs": rows}))
+
+
+if __name__ == "__main__":
+    main()

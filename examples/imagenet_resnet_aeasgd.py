@@ -1,0 +1,60 @@
+"""ImageNet ResNet + AEASGD — BASELINE.md row 3 (the flagship config).
+
+Pipeline: synthetic ImageNet-shaped data -> AEASGD (elastic averaging)
+over a worker mesh -> predict -> accuracy.  Defaults are scaled down
+(ResNet-18 at 32px, 10 classes) so the example finishes in seconds on
+CPU; ``--image-size 224 --num-classes 1000 --resnet 50`` is the real
+flagship shape for a TPU chip.
+
+Run:  python examples/imagenet_resnet_aeasgd.py --devices 8
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+
+def main():
+    parser = make_parser(__doc__, rows=256, epochs=2, batch_size=4,
+                         workers=8, window=2, learning_rate=0.02)
+    parser.add_argument("--image-size", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--resnet", type=int, choices=(18, 50),
+                        default=18)
+    parser.add_argument("--rho", type=float, default=2.5,
+                        help="elastic force (alpha = lr * rho)")
+    parser.add_argument("--fidelity", choices=("faithful", "fast"),
+                        default="faithful")
+    args = parse_args_and_setup(parser)
+
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import AEASGD
+
+    data = datasets.imagenet_synth(args.rows, image_size=args.image_size,
+                                   num_classes=args.num_classes,
+                                   seed=args.seed + 2)
+    stages = (2, 2, 2, 2) if args.resnet == 18 else (3, 4, 6, 3)
+    cfg = model_config("resnet",
+                       (args.image_size, args.image_size, 3),
+                       num_classes=args.num_classes,
+                       stage_sizes=stages,
+                       bottleneck=args.resnet == 50, dtype="float32")
+    trainer = AEASGD(cfg, num_workers=args.workers,
+                     communication_window=args.window,
+                     batch_size=args.batch_size, num_epoch=args.epochs,
+                     rho=args.rho, learning_rate=args.learning_rate,
+                     fidelity=args.fidelity, seed=args.seed,
+                     checkpoint_dir=args.checkpoint_dir)
+    variables = trainer.train(data, resume_from=args.resume)
+    metrics = evaluate_model(trainer.model, variables, data,
+                             batch_size=64)
+    report(f"imagenet_resnet{args.resnet}_aeasgd", trainer, metrics,
+           image_size=args.image_size, fidelity=args.fidelity)
+
+
+if __name__ == "__main__":
+    main()

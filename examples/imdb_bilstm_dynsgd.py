@@ -1,0 +1,48 @@
+"""IMDB BiLSTM + DynSGD — BASELINE.md row 4.
+
+Pipeline: synthetic token sequences -> BiLSTM classifier trained with
+DynSGD (staleness-scaled commits) -> predict -> accuracy.
+
+Run:  python examples/imdb_bilstm_dynsgd.py --devices 8
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+
+def main():
+    parser = make_parser(__doc__, rows=2048, epochs=3, batch_size=16,
+                         workers=4, window=2, learning_rate=0.01)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--vocab-size", type=int, default=200)
+    args = parse_args_and_setup(parser)
+
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import DynSGD
+
+    data = datasets.imdb_synth(args.rows, seq_len=args.seq_len,
+                               vocab_size=args.vocab_size,
+                               seed=args.seed + 3)
+    cfg = model_config("bilstm", (args.seq_len,), input_dtype="int32",
+                       vocab_size=args.vocab_size, embed_dim=16,
+                       hidden_dim=16, num_classes=2)
+    trainer = DynSGD(cfg, num_workers=args.workers,
+                     communication_window=args.window,
+                     batch_size=args.batch_size, num_epoch=args.epochs,
+                     learning_rate=args.learning_rate,
+                     worker_optimizer="adam", seed=args.seed,
+                     checkpoint_dir=args.checkpoint_dir)
+    variables = trainer.train(data, resume_from=args.resume)
+    metrics = evaluate_model(trainer.model, variables, data,
+                             batch_size=256)
+    report("imdb_bilstm_dynsgd", trainer, metrics,
+           seq_len=args.seq_len)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""MNIST MLP — the reference's canonical workflow (BASELINE.md row 1).
+
+Pipeline: synthetic MNIST-shaped data -> SingleTrainer (or any trainer
+via --trainer) -> sharded batch inference -> accuracy.  The analogue of
+the reference's MNIST workflow notebook, which ran every trainer on the
+same data and compared accuracies (SURVEY.md §4).
+
+Run:  python examples/mnist_mlp.py
+      python examples/mnist_mlp.py --trainer adag --devices 8
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+TRAINERS = ("single", "sync", "downpour", "adag", "aeasgd", "eamsgd",
+            "dynsgd")
+
+
+def main():
+    parser = make_parser(__doc__, rows=4096, epochs=3, batch_size=64,
+                         learning_rate=3e-3)
+    parser.add_argument("--trainer", choices=TRAINERS, default="single")
+    args = parse_args_and_setup(parser)
+
+    from distkeras_tpu import trainers
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+
+    data = datasets.mnist_synth(args.rows, seed=args.seed)
+    holdout, train = data.shard(4, 0), data.shard(4, 1).concat(
+        data.shard(4, 2)).concat(data.shard(4, 3))
+    cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
+
+    common = dict(worker_optimizer="adam",
+                  learning_rate=args.learning_rate,
+                  batch_size=args.batch_size, num_epoch=args.epochs,
+                  seed=args.seed, checkpoint_dir=args.checkpoint_dir)
+    dist = dict(num_workers=args.workers,
+                communication_window=args.window)
+    name = args.trainer
+    if name == "single":
+        trainer = trainers.SingleTrainer(cfg, **common)
+    elif name == "sync":
+        trainer = trainers.SyncTrainer(cfg, num_workers=args.workers,
+                                       **common)
+    else:
+        cls = {"downpour": trainers.DOWNPOUR, "adag": trainers.ADAG,
+               "aeasgd": trainers.AEASGD, "eamsgd": trainers.EAMSGD,
+               "dynsgd": trainers.DynSGD}[name]
+        trainer = cls(cfg, **dist, **common)
+
+    variables = trainer.train(train, resume_from=args.resume)
+    metrics = {
+        "train_accuracy": evaluate_model(
+            trainer.model, variables, train, batch_size=256)["accuracy"],
+        "holdout_accuracy": evaluate_model(
+            trainer.model, variables, holdout,
+            batch_size=256)["accuracy"],
+    }
+    report(f"mnist_mlp/{name}", trainer, metrics)
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,22 @@ def test_ensemble_rejects_resume_and_checkpoint_dir(tmp_path):
                          checkpoint_dir=str(tmp_path))
     with pytest.raises(ValueError):
         t2.train(DATA)
+
+
+def test_save_load_dotted_dir_and_explicit_file(tmp_path):
+    """Dotted directory names (runs/v1.5) are directories, not files;
+    explicit .msgpack file paths get their parents created."""
+    state = {"w": np.ones(3, np.float32)}
+    dotted = tmp_path / "runs" / "v1.5"
+    dotted.mkdir(parents=True)
+    written = save_checkpoint(dotted, state, {"epoch": 1})
+    assert written.endswith("ckpt_latest.msgpack")
+    loaded, cursor = load_checkpoint(dotted, {"w": np.zeros(3, np.float32)})
+    assert cursor == {"epoch": 1}
+    np.testing.assert_array_equal(loaded["w"], state["w"])
+
+    explicit = tmp_path / "out" / "model.msgpack"  # parent doesn't exist
+    written = save_checkpoint(explicit, state, {"epoch": 2})
+    assert written == str(explicit)
+    _, cursor = load_checkpoint(explicit, {"w": np.zeros(3, np.float32)})
+    assert cursor == {"epoch": 2}
