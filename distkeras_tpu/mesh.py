@@ -15,6 +15,7 @@ device — the ``local[N]`` analogue the reference got from Spark
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Sequence
 
 import jax
@@ -25,6 +26,112 @@ WORKER_AXIS = "workers"
 MODEL_AXIS = "model"
 
 
+def initialize_cluster(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None,
+                       local_device_ids: Sequence[int] | None = None
+                       ) -> None:
+    """Join (or form) a multi-host cluster: ``jax.distributed.initialize``.
+
+    The L0 substrate entry the reference delegated to Spark (SURVEY.md §1
+    L0: executors scheduled by the JVM; §7 L0 of the build plan).  After
+    this returns, ``jax.devices()`` is the *global* device list across
+    all processes and every mesh built from it spans hosts — the trainers
+    need no other changes because collectives ride the mesh.
+
+    On TPU pods all arguments are auto-detected from the environment;
+    elsewhere (CPU fleets, tests) pass them explicitly, or export
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``.  No-op when called twice or when running
+    single-process with no coordinator configured.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        return  # single-process run; nothing to join
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+
+
+def process_shard(dataset, seed: int | None = None):
+    """This process's rows of a logically-global ``Dataset`` — the
+    multi-host analogue of Spark shipping partitions to executors.  Every
+    process must hold the same global rows (same generator seed); the
+    optional ``seed`` applies the same cross-process shuffle first."""
+    if jax.process_count() == 1:
+        return dataset
+    if seed is not None:
+        dataset = dataset.shuffle(seed=seed)
+    return dataset.shard(jax.process_count(), jax.process_index())
+
+
+def global_batch_from_local(sharding: NamedSharding, local_tree):
+    """Assemble globally-sharded device arrays from host-local data.
+
+    ``local_tree`` is any pytree of arrays; every leaf gets ``sharding``.
+    Single-process: a plain sharded ``device_put``.  Multi-process: each
+    host contributes only its shard's rows (for replicated shardings,
+    the full replica) and ``jax.make_array_from_process_local_data``
+    stitches the global array — the DCN-free path for per-host data
+    loading (SURVEY.md §7 L0 "host-local data loading").
+    """
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, sharding), local_tree)
+
+    def put(v):
+        # Typed PRNG keys can't pass through numpy: ship the raw uint32
+        # key data and re-wrap it on the global array.
+        if hasattr(v, "dtype") and jax.dtypes.issubdtype(
+                v.dtype, jax.dtypes.prng_key):
+            data = jax.make_array_from_process_local_data(
+                sharding, np.asarray(jax.random.key_data(v)))
+            return jax.random.wrap_key_data(data)
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(v))
+
+    return jax.tree_util.tree_map(put, local_tree)
+
+
+def _select_spanning_devices(devices: Sequence[jax.Device],
+                             need: int) -> list[jax.Device]:
+    """Pick ``need`` devices such that, multi-process, every process
+    contributes an equal share (grouped by process, process-major order).
+
+    A naive ``devices[:need]`` prefix can land entirely on process 0's
+    devices, leaving other processes with no addressable shard — their
+    ``make_array_from_process_local_data`` then fails (or worse, the job
+    silently trains on a subset of the data).
+    """
+    devices = list(devices)
+    pc = jax.process_count()
+    if pc == 1:
+        return devices[:need]
+    if need % pc:
+        raise ValueError(
+            f"multi-host mesh needs a device count ({need}) divisible "
+            f"by the process count ({pc})")
+    per = need // pc
+    by_proc: dict[int, list[jax.Device]] = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    if len(by_proc) < pc or any(len(v) < per
+                                for v in by_proc.values()):
+        raise ValueError(
+            f"cannot take {per} devices from each of {pc} processes: "
+            f"per-process device counts are "
+            f"{ {p: len(v) for p, v in by_proc.items()} }")
+    return [d for p in sorted(by_proc) for d in by_proc[p][:per]]
+
+
 def create_mesh(num_workers: int | None = None,
                 model_parallel: int = 1,
                 devices: Sequence[jax.Device] | None = None) -> Mesh:
@@ -33,7 +140,8 @@ def create_mesh(num_workers: int | None = None,
     ``num_workers`` defaults to ``len(devices) // model_parallel``.  The
     worker axis is the data-parallel axis (the analogue of the reference's
     ``num_workers`` Spark partitions); the model axis hosts tensor
-    parallelism for models that shard parameters.
+    parallelism for models that shard parameters.  Multi-process, the
+    chosen devices always span every process equally.
     """
     devices = list(devices if devices is not None else jax.devices())
     if num_workers is None:
@@ -43,7 +151,8 @@ def create_mesh(num_workers: int | None = None,
         raise ValueError(
             f"mesh needs {need} devices ({num_workers} workers x "
             f"{model_parallel} model-parallel), have {len(devices)}")
-    grid = np.asarray(devices[:need]).reshape(num_workers, model_parallel)
+    chosen = _select_spanning_devices(devices, need)
+    grid = np.asarray(chosen).reshape(num_workers, model_parallel)
     return Mesh(grid, (WORKER_AXIS, MODEL_AXIS))
 
 
@@ -76,17 +185,37 @@ def place_workers(num_workers: int,
     """
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
+    pc = jax.process_count()
     mesh_workers = 1
     for cand in range(min(n_dev, num_workers), 0, -1):
-        if num_workers % cand == 0:
+        # Multi-process, only process-spanning worker counts are usable
+        # (every process must own an equal slice of the worker axis).
+        if num_workers % cand == 0 and (pc == 1 or cand % pc == 0):
             mesh_workers = cand
             break
     vmap_workers = num_workers // mesh_workers
     mesh = None
     if mesh_workers > 1:
-        mesh = Mesh(np.asarray(devices[:mesh_workers]), (WORKER_AXIS,))
+        chosen = _select_spanning_devices(devices, mesh_workers)
+        mesh = Mesh(np.asarray(chosen), (WORKER_AXIS,))
     return WorkerPlacement(mesh=mesh, mesh_workers=mesh_workers,
                            vmap_workers=vmap_workers)
+
+
+def fetch(x) -> np.ndarray:
+    """Device array -> host numpy, multi-host safe: sharded
+    non-fully-addressable arrays are allgathered (tiled, i.e. shards
+    concatenated in place), replicated ones read from a local replica
+    (single-process: a plain copy)."""
+    if jax.process_count() > 1 and hasattr(x, "is_fully_addressable") \
+            and not x.is_fully_addressable:
+        if x.sharding.is_fully_replicated:
+            return np.asarray(x.addressable_data(0))
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

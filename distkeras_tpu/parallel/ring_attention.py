@@ -32,9 +32,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-_NEG = jnp.float32(-1e30)
+# numpy, not jnp: a module-level jnp constant would initialize the XLA
+# backend at import time, breaking jax.distributed.initialize callers
+_NEG = np.float32(-1e30)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

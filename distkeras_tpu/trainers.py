@@ -219,6 +219,16 @@ class SyncTrainer(Trainer):
         num_workers = self.num_workers or len(devices)
         use_mesh = len(devices) >= num_workers > 1
         global_batch = self.batch_size * num_workers
+        # Multi-host: every process runs this same program; each holds
+        # only its rows of the (identically generated) global dataset and
+        # contributes them to the globally-sharded batch.
+        pc = jax.process_count()
+        if pc > 1:
+            if not use_mesh or global_batch % pc:
+                raise ValueError(
+                    f"multi-host SyncTrainer needs a mesh and a global "
+                    f"batch divisible by process count ({pc})")
+        local_batch = global_batch // pc
 
         tx = self._tx()
         variables = self._init_variables(initial_variables)
@@ -233,9 +243,12 @@ class SyncTrainer(Trainer):
         if use_mesh:
             m = mesh_lib.create_mesh(num_workers, devices=devices)
             rep = NamedSharding(m, P())
+            # [chunk, B_global, ...]: global batch axis sharded across
+            # workers — both the jit contract and the host-side chunk
+            # assembly below use this one sharding.
             batch_sharded = NamedSharding(
-                m, P(None, mesh_lib.WORKER_AXIS))  # [chunk, B_global, ...]
-            state = jax.device_put(state, rep)
+                m, P(None, mesh_lib.WORKER_AXIS))
+            state = mesh_lib.global_batch_from_local(rep, state)
             run_chunk = jax.jit(
                 run_chunk,
                 in_shardings=(rep, batch_sharded),
@@ -245,8 +258,9 @@ class SyncTrainer(Trainer):
 
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
-            shard = dataset.shuffle(seed=self.seed + epoch)
-            stacked = _stack_batches(shard, global_batch, self._columns())
+            shard = mesh_lib.process_shard(
+                dataset.shuffle(seed=self.seed + epoch))
+            stacked = _stack_batches(shard, local_batch, self._columns())
             if stacked is None:
                 raise ValueError(
                     f"dataset smaller than one global batch "
@@ -254,10 +268,15 @@ class SyncTrainer(Trainer):
             n = len(next(iter(stacked.values())))
             losses = []
             for lo in range(0, n, self.SCAN_CHUNK):
-                chunk = {k: jnp.asarray(v[lo:lo + self.SCAN_CHUNK])
+                local = {k: v[lo:lo + self.SCAN_CHUNK]
                          for k, v in stacked.items()}
+                if use_mesh:
+                    chunk = mesh_lib.global_batch_from_local(
+                        batch_sharded, local)
+                else:
+                    chunk = {k: jnp.asarray(v) for k, v in local.items()}
                 state, metrics = run_chunk(state, chunk)
-                losses.append(np.asarray(metrics["loss"]))
+                losses.append(mesh_lib.fetch(metrics["loss"]))
             self._record(epoch_loss=float(np.concatenate(losses).mean()))
             self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
@@ -284,6 +303,12 @@ class DistributedTrainer(Trainer):
         raise NotImplementedError
 
     def _train(self, dataset, initial_variables, resume_from=None):
+        if jax.process_count() > 1 and (self.checkpoint_dir
+                                        or resume_from):
+            raise NotImplementedError(
+                "multi-host checkpointing of sharded worker states is "
+                "not supported yet; checkpoint from a single-process "
+                "run or use SyncTrainer")
         rule = self.allocate_rule()
         tx = self._tx()
         variables = self._init_variables(initial_variables)
@@ -293,13 +318,28 @@ class DistributedTrainer(Trainer):
         num_workers = self.num_workers
         window = self.communication_window
 
+        pc, pid = jax.process_count(), jax.process_index()
+        if pc > 1 and num_workers % pc:
+            raise ValueError(
+                f"multi-host needs num_workers ({num_workers}) "
+                f"divisible by process count ({pc})")
+        local_workers = range(pid * (num_workers // pc),
+                              (pid + 1) * (num_workers // pc))
+
         # Per-worker states: identical start, distinct rng streams.
+        # Multi-host, each process materializes only its own workers'
+        # states (the key split stays global so streams are identical to
+        # a single-process run).
         def make_worker(rng):
             return TrainState.create(
                 {"params": center, **model_state}, tx, rng)
 
-        worker_states = jax.vmap(make_worker)(
-            jax.random.split(jax.random.key(self.seed + 1), num_workers))
+        worker_keys = jax.random.split(
+            jax.random.key(self.seed + 1), num_workers)
+        if pc > 1:
+            worker_keys = worker_keys[local_workers.start:
+                                      local_workers.stop]
+        worker_states = jax.vmap(make_worker)(worker_keys)
 
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
@@ -317,12 +357,21 @@ class DistributedTrainer(Trainer):
         start_round = int(cursor.get("round", 0))
 
         placement = mesh_lib.place_workers(num_workers)
+        if pc > 1 and (placement.mesh is None
+                       or placement.mesh_workers != num_workers):
+            raise ValueError(
+                "multi-host needs one mesh slot per worker "
+                f"({num_workers} workers over "
+                f"{len(jax.devices())} global devices)")
         if placement.mesh is not None:
             m = placement.mesh
             rep = NamedSharding(m, P())
             row = NamedSharding(m, P(mesh_lib.WORKER_AXIS))
-            worker_states = jax.device_put(worker_states, row)
-            ps_state = jax.device_put(ps_state, rep)
+            # Each process contributes its own workers' states (and the
+            # full replica of the PS state) to the global arrays.
+            worker_states = mesh_lib.global_batch_from_local(
+                row, worker_states)
+            ps_state = mesh_lib.global_batch_from_local(rep, ps_state)
             round_jit = jax.jit(
                 round_fn,
                 in_shardings=(rep, row, row, rep),
@@ -336,9 +385,12 @@ class DistributedTrainer(Trainer):
         for epoch in range(start_epoch, self.num_epoch):
             shard_all = dataset.shuffle(seed=self.seed + 17 * epoch)
             shards = shard_all.repartition(num_workers)
+            # Multi-host: stack only this process's workers' shards (the
+            # dataset generation is deterministic, so every process sees
+            # the same global rows and takes a disjoint slice).
             per_worker = [
-                _stack_batches(s, rows_per_worker_batch, cols)
-                for s in shards]
+                _stack_batches(shards[i], rows_per_worker_batch, cols)
+                for i in local_workers]
             if any(p is None for p in per_worker):
                 raise ValueError("a worker shard is smaller than one batch")
             n_batches = min(len(next(iter(p.values())))
@@ -373,17 +425,26 @@ class DistributedTrainer(Trainer):
                 # host (per_worker above) — host peak is one epoch, the
                 # device sees one round at a time.
                 batch = {
-                    k: jnp.asarray(np.stack(
+                    k: np.stack(
                         [p[k][r * window:(r + 1) * window]
-                         for p in per_worker]))
+                         for p in per_worker])
                     for k in cols}
+                if placement.mesh is not None:
+                    batch = mesh_lib.global_batch_from_local(row, batch)
+                    perm = mesh_lib.global_batch_from_local(
+                        rep, np.asarray(perm))
+                else:
+                    batch = {k: jnp.asarray(v)
+                             for k, v in batch.items()}
                 ps_state, worker_states, metrics = round_jit(
                     ps_state, worker_states, batch, perm)
-                round_loss = float(np.mean(metrics["loss"]))
+                round_loss = float(
+                    np.mean(mesh_lib.fetch(metrics["loss"])))
                 epoch_losses.append(round_loss)
                 self._record(
                     round_loss=round_loss,
-                    staleness=np.asarray(metrics["staleness"]).tolist())
+                    staleness=mesh_lib.fetch(
+                        metrics["staleness"]).tolist())
                 every = self.checkpoint_every_rounds
                 if every and (r + 1) % every == 0 and r + 1 < n_rounds:
                     self._maybe_save(
@@ -396,8 +457,17 @@ class DistributedTrainer(Trainer):
                  "perm_key": perm_key},
                 {"epoch": epoch + 1, "round": 0})
 
-        final_model_state = jax.tree_util.tree_map(
-            lambda x: x[0], worker_states.model_state)
+        # Keep worker 0's model state (batch stats etc.): slice on device
+        # (replicated output) so only one row ever crosses to host.
+        if placement.mesh is not None:
+            row0 = jax.jit(
+                lambda t: jax.tree_util.tree_map(lambda x: x[0], t),
+                out_shardings=rep)(worker_states.model_state)
+            final_model_state = jax.tree_util.tree_map(
+                mesh_lib.fetch, row0)
+        else:
+            final_model_state = jax.tree_util.tree_map(
+                lambda x: x[0], worker_states.model_state)
         self.trained_variables = {"params": ps_state.center,
                                   **final_model_state}
         self.parameter_server_state = jax.device_get(ps_state)

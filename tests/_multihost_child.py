@@ -1,0 +1,58 @@
+"""Child program for the multi-host integration test: joins a 2-process
+jax.distributed cluster (4 virtual CPU devices per process -> 8 global),
+trains SyncTrainer and ADAG on the deterministically-generated dataset,
+and prints one JSON line of results for the parent to compare."""
+
+import json
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import model_config
+from distkeras_tpu.trainers import ADAG, SyncTrainer
+
+
+def main():
+    mesh_lib.initialize_cluster()  # env-driven (deploy.launch_local)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    data = datasets.synthetic_classification(1024, (8,), 4, seed=0)
+    cfg = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+
+    sync = SyncTrainer(cfg, num_workers=8, batch_size=8, num_epoch=2,
+                       learning_rate=0.05)
+    sync.train(data)
+
+    adag = ADAG(cfg, num_workers=8, communication_window=2,
+                batch_size=8, num_epoch=1, learning_rate=0.05)
+    adag.train(data)
+
+    # Fewer workers than global devices: the mesh must still span both
+    # processes (regression: a device-prefix mesh landed entirely on
+    # process 0 — crash on process 1, silent half-data training on 0).
+    small = SyncTrainer(cfg, num_workers=4, batch_size=8, num_epoch=1,
+                        learning_rate=0.05)
+    small.train(data)
+
+    print(json.dumps({
+        "process": jax.process_index(),
+        "sync_epoch_loss": [round(x, 6)
+                            for x in sync.history["epoch_loss"]],
+        "adag_round_loss": [round(x, 6)
+                            for x in adag.history["round_loss"]],
+        "adag_staleness": adag.history["staleness"][-1],
+        "small_sync_loss": [round(x, 6)
+                            for x in small.history["epoch_loss"]],
+    }))
+
+
+if __name__ == "__main__":
+    main()
