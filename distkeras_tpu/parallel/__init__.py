@@ -1,3 +1,8 @@
+from distkeras_tpu.parallel.host_ps import (  # noqa: F401
+    HostParameterServer,
+    PSClient,
+    PSServer,
+)
 from distkeras_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attn_fn,

@@ -1,0 +1,225 @@
+"""Host-side concurrent parameter server — the *faithful* async arm
+(design 5a of SURVEY.md §7: "host-side PS process, per-host async client
+threads, faithful staleness behavior").
+
+Where ``ps_emulator`` compiles the whole commit round into one XLA
+program with *deterministic* staleness, this module runs the reference's
+actual concurrency model: worker threads free-running against a central
+server whose commits are serialized by a mutex, staleness emerging from
+real scheduling races (SURVEY.md §2.1 SocketParameterServer: accept
+loop, handler per connection, lock around center updates).  It reuses
+the very same ``UpdateRule`` objects as the emulator — the server law,
+payload kind, window normalization and pull law are shared code — which
+is what makes the two arms comparable: any convergence difference is
+attributable to staleness semantics, not to reimplemented math
+(VERDICT.md round-1 Missing #4).
+
+Two transports:
+* in-process — workers call the server object directly (the common case:
+  one host, threads driving device steps);
+* socket — a TCP server thread speaking the L1 framing
+  (``parallel.transport``): single-byte commands ``b"p"`` (pull) /
+  ``b"c"`` (commit payload) / ``b"s"`` (stop), msgpack parameter
+  payloads.  The reference's wire protocol, minus pickle.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
+from distkeras_tpu.utils import deserialize_params, serialize_params
+
+Pytree = Any
+
+
+def _to_numpy(tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class HostParameterServer:
+    """Threaded central state: ``pull``/``commit`` under a mutex.
+
+    Staleness bookkeeping matches the reference DynSGD server: a global
+    commit clock; a commit's staleness is the number of commits applied
+    since the committing worker's last pull (SURVEY.md §2.1
+    DynSGDParameterServer).
+    """
+
+    def __init__(self, rule: UpdateRule, center: Pytree):
+        self.rule = rule
+        self._lock = threading.Lock()
+        self._center = _to_numpy(center)
+        self._clock = 0
+        self._pull_clock: dict[int, int] = {}
+        self.staleness_log: list[int] = []
+        self.num_commits = 0
+
+    # -- the two verbs -----------------------------------------------------
+
+    def pull(self, worker_id: int) -> Pytree:
+        with self._lock:
+            self._pull_clock[worker_id] = self._clock
+            return self._center
+
+    def commit(self, worker_id: int, payload: Pytree,
+               local: Pytree | None = None) -> Pytree:
+        """Apply one commit; returns the worker's new local params (the
+        rule's pull law, evaluated against the same center the server
+        used — commit-and-pull is one atomic exchange, as in the
+        reference where the handler thread holds the connection)."""
+        with self._lock:
+            staleness = self._clock - self._pull_clock.get(worker_id, 0)
+            state = PSState(center=self._center,
+                            clock=np.int32(self._clock))
+            new_state = self.rule.commit(
+                state, payload, np.int32(staleness))
+            pulled = self.rule.worker_pull(
+                local, state.center, new_state.center)
+            self._center = _to_numpy(new_state.center)
+            self._clock += 1
+            self._pull_clock[worker_id] = self._clock
+            self.staleness_log.append(int(staleness))
+            self.num_commits += 1
+            return _to_numpy(pulled)
+
+    @property
+    def center(self) -> Pytree:
+        with self._lock:
+            return self._center
+
+
+class PSServer:
+    """TCP front end for a ``HostParameterServer``.
+
+    Protocol (all messages framed by ``transport``): first message on a
+    connection is the msgpack'd worker id (4-byte big-endian int).  Then
+    requests: ``b"p"`` -> center params; ``b"c" + params`` (+ optional
+    second frame with local params for pull-uses-local rules) -> new
+    local params.  ``b"s"`` shuts the server down.
+    """
+
+    def __init__(self, ps: HostParameterServer, template: Pytree,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.ps = ps
+        self._template = _to_numpy(template)
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = self._sock.getsockname()
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+
+    def start(self) -> "PSServer":
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        with conn:
+            try:
+                worker_id = int.from_bytes(transport.recv_msg(conn),
+                                           "big")
+                while True:
+                    msg = transport.recv_msg(conn)
+                    cmd, body = msg[:1], msg[1:]
+                    if cmd == b"p":
+                        transport.send_msg(conn, serialize_params(
+                            self.ps.pull(worker_id)))
+                    elif cmd == b"c":
+                        payload = deserialize_params(self._template,
+                                                     body)
+                        local = None
+                        if self.ps.rule.pull_uses_local:
+                            local = deserialize_params(
+                                self._template,
+                                transport.recv_msg(conn))
+                        pulled = self.ps.commit(worker_id, payload,
+                                                local)
+                        transport.send_msg(conn,
+                                           serialize_params(pulled))
+                    elif cmd == b"s":
+                        self._stop.set()
+                        return
+                    else:
+                        raise ValueError(f"unknown command {cmd!r}")
+            except (ConnectionError, OSError):
+                return  # client gone; reference handlers did the same
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class PSClient:
+    """Worker-side connection to a ``PSServer`` (one per worker thread,
+    as the reference opened one socket per Spark task)."""
+
+    def __init__(self, host: str, port: int, worker_id: int,
+                 template: Pytree):
+        self._sock = transport.connect(host, port, timeout=30.0)
+        self._template = _to_numpy(template)
+        transport.send_msg(self._sock, int(worker_id).to_bytes(4, "big"))
+
+    def pull(self) -> Pytree:
+        transport.send_msg(self._sock, b"p")
+        return deserialize_params(self._template,
+                                  transport.recv_msg(self._sock))
+
+    def commit(self, payload: Pytree,
+               local: Pytree | None = None) -> Pytree:
+        transport.send_msg(self._sock, b"c",
+                           serialize_params(_to_numpy(payload)))
+        if local is not None:
+            transport.send_msg(self._sock,
+                               serialize_params(_to_numpy(local)))
+        return deserialize_params(self._template,
+                                  transport.recv_msg(self._sock))
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def stop_server(host: str, port: int):
+    """Ask a ``PSServer`` to shut down (the reference's stop command)."""
+    sock = transport.connect(host, port, timeout=10.0)
+    try:
+        transport.send_msg(sock, (0).to_bytes(4, "big"))
+        transport.send_msg(sock, b"s")
+    finally:
+        sock.close()

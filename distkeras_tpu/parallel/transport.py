@@ -1,0 +1,63 @@
+"""Socket transport: length-prefix framing + msgpack payloads.
+
+The analogue of the reference's ``distkeras/networking.py`` (SURVEY.md §1
+L1, §2.4): ``connect`` / ``send_msg`` / ``recv_msg`` with a fixed 8-byte
+big-endian length header and a ``recvall`` loop, plus
+``determine_host_address``.  Two deliberate departures from the
+reference: payloads are msgpack maps of raw tensor bytes
+(``utils.serialize_params``), never pickle (no arbitrary-object
+execution on receive), and Nagle is disabled on both ends (the PS
+exchange is latency-bound request/response traffic).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+_HEADER = struct.Struct(">Q")
+MAX_MSG_BYTES = 1 << 40  # sanity bound for the length header
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (the reference used the
+    same trick: open a UDP socket to a public address and read the local
+    endpoint; no traffic is sent)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def connect(host: str, port: int, timeout: float | None = None
+            ) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def send_msg(sock: socket.socket, *parts: bytes) -> None:
+    """Send one framed message made of ``parts`` (concatenated headers
+    let a request carry a command byte + payload without copies)."""
+    total = sum(len(p) for p in parts)
+    sock.sendall(_HEADER.pack(total) + b"".join(parts))
+
+
+def _recvall(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    (length,) = _HEADER.unpack(_recvall(sock, _HEADER.size))
+    if length > MAX_MSG_BYTES:
+        raise ValueError(f"message length {length} exceeds sanity bound")
+    return _recvall(sock, length)

@@ -292,17 +292,32 @@ class DistributedTrainer(Trainer):
     def __init__(self, model, num_workers: int = 2,
                  communication_window: int = 5,
                  fidelity: str = "faithful",
+                 transport: str = "inprocess",
                  checkpoint_every_rounds: int | None = None, **kwargs):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.fidelity = fidelity
+        self.transport = transport
         self.checkpoint_every_rounds = checkpoint_every_rounds
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
 
     def _train(self, dataset, initial_variables, resume_from=None):
+        if self.fidelity == "host":
+            if resume_from or self.checkpoint_dir:
+                raise NotImplementedError(
+                    "fidelity='host' is the nondeterministic faithful "
+                    "arm; checkpoint/resume of racing threads is not "
+                    "supported — use the emulated fidelities")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "fidelity='host' runs one in-process PS per "
+                    "process and would train divergent replicas "
+                    "multi-host; use the emulated fidelities (or a "
+                    "single process with transport='socket')")
+            return self._train_host(dataset, initial_variables)
         if jax.process_count() > 1 and (self.checkpoint_dir
                                         or resume_from):
             raise NotImplementedError(
@@ -471,6 +486,149 @@ class DistributedTrainer(Trainer):
         self.trained_variables = {"params": ps_state.center,
                                   **final_model_state}
         self.parameter_server_state = jax.device_get(ps_state)
+        return self.trained_variables
+
+
+    def _train_host(self, dataset, initial_variables):
+        """Design 5a (SURVEY.md §7): free-running worker threads against
+        a concurrent host-side parameter server.  Real races, emergent
+        staleness — the faithful arm the on-mesh emulator's deterministic
+        staleness is validated against.  See ``parallel.host_ps``."""
+        import threading
+
+        from distkeras_tpu.parallel.host_ps import (
+            HostParameterServer, PSClient, PSServer)
+        from distkeras_tpu.utils import tree_sub
+
+        rule = self.allocate_rule()
+        tx = self._tx()
+        variables = self._init_variables(initial_variables)
+        center = variables["params"]
+        model_state = {k: v for k, v in variables.items()
+                       if k != "params"}
+        num_workers = self.num_workers
+        window = self.communication_window
+
+        ps = HostParameterServer(rule, center)
+        server = None
+        if self.transport == "socket":
+            server = PSServer(ps, center).start()
+        elif self.transport != "inprocess":
+            raise ValueError(
+                f"unknown transport {self.transport!r}; "
+                "expected 'inprocess' or 'socket'")
+
+        step = make_train_step(self.model, self.loss, tx,
+                               self.features_col, self.label_col)
+        run_window = jax.jit(make_window_runner(step))
+        worker_keys = jax.random.split(
+            jax.random.key(self.seed + 1), num_workers)
+        cols = self._columns()
+        history_lock = threading.Lock()
+        round_records: list[tuple[int, int, float]] = []
+        errors: list[BaseException] = []
+
+        # Threads free-run through epochs, so the per-epoch shuffle +
+        # repartition is memoized under a lock: the first worker to
+        # reach epoch e builds the shards once (not one full-dataset
+        # copy per thread); entries are dropped after the last worker
+        # fetches them.
+        shard_lock = threading.Lock()
+        shard_cache: dict[int, tuple[list, int]] = {}
+
+        def epoch_shard(epoch: int, w: int):
+            with shard_lock:
+                if epoch not in shard_cache:
+                    shard_cache[epoch] = (
+                        dataset.shuffle(
+                            seed=self.seed + 17 * epoch
+                        ).repartition(num_workers), 0)
+                shards, fetched = shard_cache[epoch]
+                shard = shards[w]
+                if fetched + 1 == num_workers:
+                    del shard_cache[epoch]
+                else:
+                    shard_cache[epoch] = (shards, fetched + 1)
+                return shard
+
+        def worker_loop(w: int):
+            try:
+                client = None
+                if server is not None:
+                    client = PSClient(*server.address, worker_id=w,
+                                      template=center)
+                    pull = client.pull
+                    commit = client.commit
+                else:
+                    pull = lambda: ps.pull(w)  # noqa: E731
+                    commit = lambda p, l=None: ps.commit(w, p, l)  # noqa: E731,E501
+
+                state = TrainState.create(
+                    {"params": center, **model_state}, tx,
+                    worker_keys[w])
+                pulled = pull()
+                for epoch in range(self.num_epoch):
+                    stacked = _stack_batches(epoch_shard(epoch, w),
+                                             self.batch_size, cols)
+                    if stacked is None:
+                        raise ValueError(
+                            f"worker {w} shard smaller than one batch")
+                    n_batches = len(next(iter(stacked.values())))
+                    if n_batches // window == 0:
+                        raise ValueError(
+                            f"not enough batches per worker "
+                            f"({n_batches}) for one communication "
+                            f"window ({window})")
+                    for r in range(n_batches // window):
+                        start_params = jax.tree_util.tree_map(
+                            jnp.asarray, pulled)
+                        state = state.replace(params=start_params)
+                        batches = {
+                            k: jnp.asarray(
+                                v[r * window:(r + 1) * window])
+                            for k, v in stacked.items()}
+                        state, metrics = run_window(state, batches)
+                        if rule.payload_kind == "params":
+                            payload, local = state.params, state.params
+                        else:
+                            payload = rule.normalize_delta(
+                                tree_sub(state.params, start_params),
+                                window)
+                            local = None
+                        pulled = commit(
+                            payload,
+                            local if rule.pull_uses_local else None)
+                        with history_lock:
+                            round_records.append(
+                                (w, epoch,
+                                 float(np.mean(
+                                     np.asarray(metrics["loss"])))))
+                if client is not None:
+                    client.close()
+            except BaseException as e:  # surfaced to the caller below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker_loop, args=(w,))
+                   for w in range(num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if server is not None:
+            server.stop()
+        if errors:
+            raise errors[0]
+
+        for _, _, loss in round_records:
+            self._record(round_loss=loss)
+        for epoch in range(self.num_epoch):
+            losses = [l for (_, e, l) in round_records if e == epoch]
+            self._record(epoch_loss=float(np.mean(losses)))
+        self._record(staleness=list(ps.staleness_log))
+        self.parameter_server_state = ps
+        self.trained_variables = {
+            "params": jax.tree_util.tree_map(jnp.asarray, ps.center),
+            **model_state}
         return self.trained_variables
 
 

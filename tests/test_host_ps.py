@@ -1,0 +1,175 @@
+"""Host-side concurrent PS (design 5a) — transport framing, serial
+equivalence against the emulator's scan path, convergence of the
+threaded faithful arm, and the socket protocol end to end."""
+
+import socket
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import datasets
+from distkeras_tpu.evaluators import evaluate_model
+from distkeras_tpu.models import model_config
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.parallel.host_ps import (
+    HostParameterServer,
+    PSClient,
+    PSServer,
+)
+from distkeras_tpu.parallel.update_rules import (
+    AdagRule,
+    DynSGDRule,
+    ElasticRule,
+    apply_commit_round,
+)
+from distkeras_tpu.trainers import ADAG, AEASGD, DOWNPOUR
+from distkeras_tpu.utils import tree_sub
+
+MLP = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+DATA = datasets.synthetic_classification(2048, (8,), 4, seed=0)
+
+
+def _params(seed=0, shapes=((3, 4), (4,))):
+    rng = np.random.default_rng(seed)
+    return {f"w{i}": rng.normal(size=s).astype(np.float32)
+            for i, s in enumerate(shapes)}
+
+
+def test_transport_framing_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        transport.send_msg(a, b"c", b"x" * 100_000)
+        msg = transport.recv_msg(b)
+        assert msg[:1] == b"c" and len(msg) == 100_001
+        transport.send_msg(b, b"")
+        assert transport.recv_msg(a) == b""
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize("rule", [AdagRule(), DynSGDRule(),
+                                  ElasticRule(alpha=0.3)])
+def test_host_ps_serial_matches_scan_round(rule):
+    """The emulator's round scenario replayed through the threaded
+    server — every worker pulls at round start, then commits land in
+    order (so commit i has staleness i): the center and staleness
+    sequence must match the scan path exactly (same UpdateRule code on
+    both sides, so any divergence would be a transport/ordering bug)."""
+    center = _params(0)
+    payloads = [_params(i + 1) for i in range(4)]
+
+    ps = HostParameterServer(rule, center)
+    for w in range(4):
+        ps.pull(w)
+    for w, p in enumerate(payloads):
+        ps.commit(w, p, p)
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *payloads)
+    state, _, _ = apply_commit_round(
+        rule, rule.init_state(center), stacked)
+    for k in center:
+        np.testing.assert_allclose(ps.center[k],
+                                   np.asarray(state.center[k]),
+                                   rtol=1e-6, atol=1e-6)
+    # the i-th commit of the round observed i intervening commits
+    assert ps.staleness_log == [0, 1, 2, 3]
+
+
+def test_host_ps_concurrent_staleness_and_consistency():
+    """N racing threads: commits all land (clock == total), staleness is
+    emergent but bounded, center stays finite."""
+    rule = AdagRule()
+    center = _params(0)
+    ps = HostParameterServer(rule, center)
+    n_threads, n_commits = 4, 8
+
+    def run(w):
+        ps.pull(w)
+        for i in range(n_commits):
+            ps.commit(w, _params(w * 100 + i),)
+
+    threads = [threading.Thread(target=run, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ps.num_commits == n_threads * n_commits
+    assert len(ps.staleness_log) == n_threads * n_commits
+    assert max(ps.staleness_log) <= n_threads * n_commits
+    assert all(np.isfinite(v).all() for v in ps.center.values())
+
+
+def test_adag_host_fidelity_converges_and_matches_emulator():
+    """The faithful host arm must reach the emulated arm's quality on
+    the same budget — the convergence-equivalence evidence SURVEY.md §7
+    hard part #1 calls for."""
+    kwargs = dict(num_workers=4, communication_window=2, batch_size=16,
+                  num_epoch=3, learning_rate=5e-3,
+                  worker_optimizer="adam")
+    host = ADAG(MLP, fidelity="host", **kwargs)
+    host.train(DATA)
+    emu = ADAG(MLP, fidelity="faithful", **kwargs)
+    emu.train(DATA)
+
+    acc_host = evaluate_model(host.model, host.trained_variables,
+                              DATA)["accuracy"]
+    acc_emu = evaluate_model(emu.model, emu.trained_variables,
+                             DATA)["accuracy"]
+    assert acc_host > 0.7, (acc_host, host.history["epoch_loss"])
+    assert abs(acc_host - acc_emu) < 0.15, (acc_host, acc_emu)
+    # emergent staleness was recorded
+    stal = host.history["staleness"][-1]
+    assert len(stal) == len(host.history["round_loss"])
+
+
+def test_aeasgd_host_fidelity_converges():
+    """Elastic family through the host arm (exercises the
+    pull-uses-local path and params payload kind)."""
+    t = AEASGD(MLP, fidelity="host", num_workers=4,
+               communication_window=2, batch_size=16, num_epoch=3,
+               rho=2.5, learning_rate=0.02)
+    t.train(DATA)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0], h
+
+
+def test_downpour_socket_transport_end_to_end():
+    """Full TCP path: workers talk to the PS over the L1 framing."""
+    t = DOWNPOUR(MLP, fidelity="host", transport="socket",
+                 num_workers=3, communication_window=2, batch_size=16,
+                 num_epoch=2, learning_rate=0.01,
+                 worker_optimizer="adam")
+    t.train(DATA)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0] * 1.05, h
+    assert t.parameter_server_state.num_commits == \
+        sum(1 for _ in t.history["round_loss"])
+
+
+def test_ps_server_client_protocol():
+    """Socket protocol unit: pull returns center; commit applies and
+    returns the pulled params."""
+    rule = ElasticRule(alpha=0.5)
+    center = _params(3)
+    ps = HostParameterServer(rule, center)
+    with PSServer(ps, center) as server:
+        client = PSClient(*server.address, worker_id=7,
+                          template=center)
+        got = client.pull()
+        for k in center:
+            np.testing.assert_allclose(got[k], center[k])
+        local = _params(4)
+        pulled = client.commit(local, local)
+        want = jax.tree_util.tree_map(
+            lambda l, c: l + 0.5 * (c - l), local, center)
+        for k in center:
+            np.testing.assert_allclose(pulled[k], np.asarray(want[k]),
+                                       rtol=1e-6)
+        client.close()
+    assert ps.num_commits == 1
