@@ -65,14 +65,42 @@ class LossEvaluator:
             np.asarray(dataset[self.label_col]))))
 
 
+def metrics_from_logits(logits, labels, *,
+                        top_k: int | None = None) -> dict[str, float]:
+    """Accuracy metrics from raw logits via the jittable ``ops.metrics``
+    functions.  Label columns may be integer ids ``[N]``, a column
+    vector of ids ``[N, 1]`` (squeezed — argmaxing it would zero every
+    label), or one-hot ``[N, C]`` (argmaxed).  Single-logit heads use
+    ``binary_accuracy``; ``top_k`` adds ``top{k}_accuracy`` for
+    multi-class heads."""
+    from distkeras_tpu.ops import metrics as M
+
+    logits = np.asarray(logits)
+    labels = np.asarray(labels)
+    if labels.ndim == logits.ndim:
+        if labels.shape[-1] > 1:
+            labels = np.argmax(labels, axis=-1)  # one-hot column
+        else:
+            labels = np.squeeze(labels, axis=-1)  # column vector of ids
+    if logits.shape[-1] == 1:
+        return {"accuracy": float(M.binary_accuracy(logits, labels))}
+    out = {"accuracy": float(M.accuracy(logits, labels))}
+    if top_k is not None and logits.shape[-1] > top_k:
+        out[f"top{top_k}_accuracy"] = float(
+            M.top_k_accuracy(logits, labels, k=top_k))
+    return out
+
+
 def evaluate_model(model, variables: Mapping, dataset: Dataset, *,
                    features_col: str = "features",
                    label_col: str = "label",
-                   batch_size: int = 512) -> dict[str, float]:
-    """One-call accuracy for a trained model (predict + evaluate)."""
+                   batch_size: int = 512,
+                   top_k: int | None = None) -> dict[str, float]:
+    """One-call evaluation for a trained model: sharded batch inference
+    to logits, then ``metrics_from_logits``."""
     predictor = ModelPredictor(model, variables,
                                features_col=features_col,
-                               output="class", batch_size=batch_size)
+                               output="logits", batch_size=batch_size)
     scored = predictor.predict(dataset)
-    acc = AccuracyEvaluator("prediction", label_col).evaluate(scored)
-    return {"accuracy": acc}
+    return metrics_from_logits(scored["prediction"],
+                               dataset[label_col], top_k=top_k)

@@ -125,18 +125,28 @@ class PSServer:
 
     def _accept_loop(self):
         self._sock.settimeout(0.2)
-        while not self._stop.is_set():
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                1)
+                t = threading.Thread(target=self._serve, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        finally:
+            # however the loop exits (stop() or the b"s" command), stop
+            # listening — a bound-but-dead port accepts TCP connects
+            # from health checks/reconnects that then hang
             try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
+                self._sock.close()
             except OSError:
-                break
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._serve, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+                pass
 
     def _serve(self, conn: socket.socket):
         with conn:
