@@ -109,12 +109,19 @@ class MinMaxTransformer(Transformer):
     def transform(self, dataset: Dataset) -> Dataset:
         if self.min_ is None:
             raise RuntimeError("fit() before transform()")
+        from distkeras_tpu import native
+
         col = np.asarray(dataset[self.input_col], dtype=np.float32)
         span = np.where(self.max_ > self.min_, self.max_ - self.min_, 1.0)
-        unit = (col - self.min_) / span
-        out = unit * (self.new_max - self.new_min) + self.new_min
-        return dataset.with_column(self.output_col,
-                                   out.astype(np.float32))
+        if native.available():
+            scale = (self.new_max - self.new_min) / span
+            out = native.affine_scale(col, scale,
+                                      self.new_min - self.min_ * scale)
+        else:
+            unit = (col - self.min_) / span
+            out = (unit * (self.new_max - self.new_min)
+                   + self.new_min).astype(np.float32)
+        return dataset.with_column(self.output_col, out)
 
 
 class StandardScaleTransformer(Transformer):
@@ -137,10 +144,16 @@ class StandardScaleTransformer(Transformer):
     def transform(self, dataset: Dataset) -> Dataset:
         if self.mean_ is None:
             raise RuntimeError("fit() before transform()")
+        from distkeras_tpu import native
+
         col = np.asarray(dataset[self.input_col], dtype=np.float32)
-        out = (col - self.mean_) / (self.std_ + self.epsilon)
-        return dataset.with_column(self.output_col,
-                                   out.astype(np.float32))
+        if native.available():
+            scale = 1.0 / (self.std_ + self.epsilon)
+            out = native.affine_scale(col, scale, -self.mean_ * scale)
+        else:
+            out = ((col - self.mean_)
+                   / (self.std_ + self.epsilon)).astype(np.float32)
+        return dataset.with_column(self.output_col, out)
 
 
 class ReshapeTransformer(Transformer):
@@ -177,13 +190,18 @@ class DenseTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, dataset: Dataset) -> Dataset:
+        from distkeras_tpu import native
+
         idx = np.asarray(dataset[self.indices_col], dtype=np.int64)
         val = np.asarray(dataset[self.values_col], dtype=np.float32)
-        n = len(dataset)
-        out = np.zeros((n, self.dim), dtype=np.float32)
-        valid = idx >= 0
-        rows = np.broadcast_to(np.arange(n)[:, None], idx.shape)
-        out[rows[valid], idx[valid]] = val[valid]
+        if native.available():
+            out = native.dense_scatter(idx, val, self.dim)
+        else:
+            n = len(dataset)
+            out = np.zeros((n, self.dim), dtype=np.float32)
+            valid = idx >= 0
+            rows = np.broadcast_to(np.arange(n)[:, None], idx.shape)
+            out[rows[valid], idx[valid]] = val[valid]
         return dataset.with_column(self.output_col, out)
 
 
@@ -230,9 +248,16 @@ class HashBucketTransformer(Transformer):
         return h
 
     def transform(self, dataset: Dataset) -> Dataset:
+        from distkeras_tpu import native
+
         col = np.asarray(dataset[self.input_col])
-        h = self._fnv1a_vectorized(col)
-        out = (h % np.uint64(self.num_buckets)).astype(np.int32)
+        if native.available():
+            s = np.char.encode(col.astype(str), "utf-8")
+            out = native.fnv1a_bucket(s, np.char.str_len(s),
+                                      self.num_buckets)
+        else:
+            h = self._fnv1a_vectorized(col)
+            out = (h % np.uint64(self.num_buckets)).astype(np.int32)
         return dataset.with_column(self.output_col, out)
 
 

@@ -1,0 +1,158 @@
+"""Loader for the native columnar kernels (``native/columnar.cpp``).
+
+Builds the shared library with the system C++ compiler on first use
+(cached next to the source, keyed by a source hash) and exposes ctypes
+wrappers.  Every entry point has a numpy fallback in
+``data/transformers.py``; ``available()`` gates the fast path, and
+``DISTKERAS_TPU_DISABLE_NATIVE=1`` forces the fallback (e.g. for
+environments without a toolchain — nothing in the framework *requires*
+the native path, it is the host-side ETL fast lane).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = pathlib.Path(__file__).resolve().parent.parent / "native" / \
+    "columnar.cpp"
+_BUILD_DIR = pathlib.Path(__file__).resolve().parent / "_native_build"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed: str | None = None
+
+
+def _compiler() -> str | None:
+    for cc in ("g++", "clang++", "c++"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+def _build() -> ctypes.CDLL:
+    src = _SRC.read_text()
+    tag = hashlib.sha256(src.encode()).hexdigest()[:16]
+    out = _BUILD_DIR / f"columnar-{tag}.so"
+    if not out.exists():
+        cc = _compiler()
+        if cc is None:
+            raise RuntimeError("no C++ compiler on PATH")
+        _BUILD_DIR.mkdir(exist_ok=True)
+        # per-process tmp name: concurrent builders (pytest workers,
+        # multi-host shared FS) must not write the same inode; the
+        # rename then makes whichever finishes last win atomically
+        tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+        subprocess.run(
+            [cc, "-O3", "-shared", "-fPIC", "-std=c++17",
+             str(_SRC), "-o", str(tmp)],
+            check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    return ctypes.CDLL(str(out))
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _failed
+    if _lib is not None or _failed is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed is not None:
+            return _lib
+        if os.environ.get("DISTKERAS_TPU_DISABLE_NATIVE") == "1":
+            _failed = "disabled by DISTKERAS_TPU_DISABLE_NATIVE"
+            return None
+        try:
+            lib = _build()
+        except (RuntimeError, OSError,
+                subprocess.CalledProcessError) as e:
+            _failed = f"native build unavailable: {e}"
+            return None
+        lib.fnv1a_bucket.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+        lib.affine_scale.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.dense_scatter.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def why_unavailable() -> str | None:
+    _load()
+    return _failed
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def fnv1a_bucket(fixed_width_bytes: np.ndarray, lengths: np.ndarray,
+                 num_buckets: int) -> np.ndarray:
+    """FNV-1a bucket ids for a numpy ``S``-dtype array (one hash per
+    row over its real bytes)."""
+    lib = _load()
+    assert lib is not None, "check available() first"
+    s = np.ascontiguousarray(fixed_width_bytes)
+    width = s.dtype.itemsize
+    n = len(s)
+    mat = np.frombuffer(s.tobytes(), dtype=np.uint8).reshape(n, width)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    out = np.empty(n, dtype=np.int32)
+    lib.fnv1a_bucket(_ptr(mat), n, width, _ptr(lengths),
+                     ctypes.c_uint64(num_buckets), _ptr(out))
+    return out
+
+
+def affine_scale(col: np.ndarray, scale: np.ndarray,
+                 shift: np.ndarray) -> np.ndarray:
+    """``col * scale + shift`` column-wise; ``col`` is float32
+    ``[N, ...]`` (trailing dims flattened), scale/shift float64 per
+    column."""
+    lib = _load()
+    assert lib is not None, "check available() first"
+    col = np.ascontiguousarray(col, dtype=np.float32)
+    rows = col.shape[0]
+    cols = int(np.prod(col.shape[1:])) if col.ndim > 1 else 1
+    # ravel: per-column stats of an [N, 28, 28] feature column arrive
+    # shaped (28, 28); the kernel is flat per trailing element
+    scale = np.ascontiguousarray(np.broadcast_to(
+        np.asarray(scale, np.float64).ravel(), (cols,)))
+    shift = np.ascontiguousarray(np.broadcast_to(
+        np.asarray(shift, np.float64).ravel(), (cols,)))
+    out = np.empty_like(col)
+    lib.affine_scale(_ptr(col), rows, cols, _ptr(scale), _ptr(shift),
+                     _ptr(out))
+    return out
+
+
+def dense_scatter(indices: np.ndarray, values: np.ndarray,
+                  dim: int) -> np.ndarray:
+    """Padded ``(indices, values)`` rows -> dense ``[N, dim]`` float32
+    (pad index < 0 ignored)."""
+    lib = _load()
+    assert lib is not None, "check available() first"
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    val = np.ascontiguousarray(values, dtype=np.float32)
+    if idx.size and idx.max() >= dim:
+        # match the numpy fallback, which raises IndexError here —
+        # malformed sparse data must fail loudly on both paths
+        raise IndexError(
+            f"sparse index {int(idx.max())} out of bounds for dim {dim}")
+    rows, nnz = idx.shape
+    out = np.zeros((rows, dim), dtype=np.float32)
+    lib.dense_scatter(_ptr(idx), _ptr(val), rows, nnz, dim, _ptr(out))
+    return out

@@ -784,22 +784,30 @@ class _MemberParallelTrainer(Trainer):
         vrun = jax.vmap(make_window_runner(step))
 
         placement = mesh_lib.place_workers(n)
+        self._member_placement = placement
         if placement.mesh is not None:
             m = placement.mesh
             # member axis sharded across the mesh for states and batches
             row = NamedSharding(m, P(mesh_lib.WORKER_AXIS))
+            self._member_sharding = row
             states = mesh_lib.global_batch_from_local(row, states)
             vrun = jax.jit(vrun, in_shardings=(row, row),
                            out_shardings=(row, row))
         else:
+            self._member_sharding = None
             vrun = jax.jit(vrun)
 
         cols = self._columns()
+        # Partition ONCE: member i sees only its own 1/n of the data for
+        # the whole run (the disjointness ensembling's variance reduction
+        # rests on); only the within-shard batch order reshuffles.
+        member_shards = dataset.repartition(n)
         for epoch in range(self.num_epoch):
-            shards = dataset.shuffle(
-                seed=self.seed + 13 * epoch).repartition(n)
-            per_member = [_stack_batches(s, self.batch_size, cols)
-                          for s in shards]
+            per_member = [
+                _stack_batches(
+                    s.shuffle(seed=self.seed + 13 * epoch + i),
+                    self.batch_size, cols)
+                for i, s in enumerate(member_shards)]
             if any(p is None for p in per_member):
                 raise ValueError(
                     "a member shard is smaller than one batch")
@@ -873,15 +881,23 @@ class AveragingTrainer(_MemberParallelTrainer):
     def _train(self, dataset, initial_variables, resume_from=None):
         self._guard_no_checkpoint(resume_from)
         states = self._train_members(dataset, initial_variables)
-        # Mean over the member axis on device (one ICI reduce when the
-        # member axis is mesh-sharded), then fetch.
-        avg_params = jax.jit(
-            lambda p: jax.tree_util.tree_map(
-                lambda x: x.mean(axis=0), p))(states.params)
-        member0_state = jax.tree_util.tree_map(
-            lambda x: mesh_lib.fetch(x)[0], states.model_state)
+
+        # Mean over the member axis + member 0's model state, both on
+        # device (one ICI reduce / slice when members are mesh-sharded)
+        # so only the final values cross to host.
+        def finalize(s):
+            return (jax.tree_util.tree_map(lambda x: x.mean(axis=0),
+                                           s.params),
+                    jax.tree_util.tree_map(lambda x: x[0],
+                                           s.model_state))
+
+        row = self._member_sharding
+        fin = (jax.jit(finalize, out_shardings=NamedSharding(
+                   self._member_placement.mesh, P()))
+               if row is not None else jax.jit(finalize))
+        avg_params, member0_state = fin(states)
         self.trained_variables = {
             "params": jax.tree_util.tree_map(mesh_lib.fetch,
                                              avg_params),
-            **member0_state}
+            **jax.tree_util.tree_map(mesh_lib.fetch, member0_state)}
         return self.trained_variables

@@ -1,0 +1,68 @@
+// Native columnar ETL kernels (C ABI, loaded via ctypes —
+// distkeras_tpu/native.py builds this with g++ on first use).
+//
+// The reference is pure Python and delegates its host-side data work to
+// Spark executors (SURVEY.md §2.2 "no native components"); the rebuild's
+// hot host-side ETL loops — categorical hashing, affine feature scaling,
+// sparse->dense scatter — run here instead of through numpy's
+// per-column-fold / fancy-indexing paths.  Kernels are deliberately
+// dependency-free scalar loops: -O3 autovectorizes the inner loops, and
+// semantics exactly match the numpy reference implementations in
+// data/transformers.py (tests assert parity).
+
+#include <cstdint>
+
+extern "C" {
+
+// FNV-1a (64-bit) over each row's bytes, reduced mod num_buckets.
+// data: [n, width] row-major fixed-width byte matrix (numpy 'S' dtype
+// buffer); lengths[i] gives row i's real byte count.
+void fnv1a_bucket(const uint8_t* data, int64_t n, int64_t width,
+                  const int64_t* lengths, uint64_t num_buckets,
+                  int32_t* out) {
+  const uint64_t kOffset = 0xcbf29ce484222325ULL;
+  const uint64_t kPrime = 0x100000001b3ULL;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* row = data + i * width;
+    const int64_t len = lengths[i];
+    uint64_t h = kOffset;
+    for (int64_t j = 0; j < len; ++j) {
+      h = (h ^ static_cast<uint64_t>(row[j])) * kPrime;
+    }
+    out[i] = static_cast<int32_t>(h % num_buckets);
+  }
+}
+
+// Column-wise affine map: out[i,c] = f32(in[i,c] * scale[c] + shift[c]).
+// Covers MinMax (scale = range_ratio/span, shift = new_min - min*scale)
+// and StandardScale (scale = 1/(std+eps), shift = -mean*scale); the
+// f64 accumulate matches the numpy paths' broadcast-to-f64 behavior.
+void affine_scale(const float* in, int64_t rows, int64_t cols,
+                  const double* scale, const double* shift, float* out) {
+  for (int64_t i = 0; i < rows; ++i) {
+    const float* src = in + i * cols;
+    float* dst = out + i * cols;
+    for (int64_t c = 0; c < cols; ++c) {
+      dst[c] = static_cast<float>(
+          static_cast<double>(src[c]) * scale[c] + shift[c]);
+    }
+  }
+}
+
+// Sparse (indices, values) padded pairs -> dense rows.
+// idx: [rows, nnz] (pad entries < 0 ignored), out: [rows, dim] zeroed
+// by the caller.
+void dense_scatter(const int64_t* idx, const float* val, int64_t rows,
+                   int64_t nnz, int64_t dim, float* out) {
+  for (int64_t i = 0; i < rows; ++i) {
+    float* dst = out + i * dim;
+    for (int64_t j = 0; j < nnz; ++j) {
+      const int64_t k = idx[i * nnz + j];
+      if (k >= 0 && k < dim) {
+        dst[k] = val[i * nnz + j];
+      }
+    }
+  }
+}
+
+}  // extern "C"
