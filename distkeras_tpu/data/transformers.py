@@ -14,6 +14,7 @@ from typing import Sequence
 
 import numpy as np
 
+from distkeras_tpu import native
 from distkeras_tpu.data.dataset import Dataset
 
 
@@ -109,8 +110,6 @@ class MinMaxTransformer(Transformer):
     def transform(self, dataset: Dataset) -> Dataset:
         if self.min_ is None:
             raise RuntimeError("fit() before transform()")
-        from distkeras_tpu import native
-
         col = np.asarray(dataset[self.input_col], dtype=np.float32)
         span = np.where(self.max_ > self.min_, self.max_ - self.min_, 1.0)
         if native.available():
@@ -144,8 +143,6 @@ class StandardScaleTransformer(Transformer):
     def transform(self, dataset: Dataset) -> Dataset:
         if self.mean_ is None:
             raise RuntimeError("fit() before transform()")
-        from distkeras_tpu import native
-
         col = np.asarray(dataset[self.input_col], dtype=np.float32)
         if native.available():
             scale = 1.0 / (self.std_ + self.epsilon)
@@ -190,8 +187,6 @@ class DenseTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, dataset: Dataset) -> Dataset:
-        from distkeras_tpu import native
-
         idx = np.asarray(dataset[self.indices_col], dtype=np.int64)
         val = np.asarray(dataset[self.values_col], dtype=np.float32)
         if native.available():
@@ -248,8 +243,6 @@ class HashBucketTransformer(Transformer):
         return h
 
     def transform(self, dataset: Dataset) -> Dataset:
-        from distkeras_tpu import native
-
         col = np.asarray(dataset[self.input_col])
         if native.available():
             s = np.char.encode(col.astype(str), "utf-8")

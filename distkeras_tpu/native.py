@@ -70,8 +70,11 @@ def _load() -> ctypes.CDLL | None:
             return None
         try:
             lib = _build()
-        except (RuntimeError, OSError,
-                subprocess.CalledProcessError) as e:
+        except subprocess.CalledProcessError as e:
+            _failed = (f"native build unavailable: {e}\n"
+                       f"{e.stderr}")  # the compiler diagnostic
+            return None
+        except (RuntimeError, OSError) as e:
             _failed = f"native build unavailable: {e}"
             return None
         lib.fnv1a_bucket.argtypes = [
@@ -109,7 +112,7 @@ def fnv1a_bucket(fixed_width_bytes: np.ndarray, lengths: np.ndarray,
     s = np.ascontiguousarray(fixed_width_bytes)
     width = s.dtype.itemsize
     n = len(s)
-    mat = np.frombuffer(s.tobytes(), dtype=np.uint8).reshape(n, width)
+    mat = s.view(np.uint8).reshape(n, width)  # zero-copy
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
     out = np.empty(n, dtype=np.int32)
     lib.fnv1a_bucket(_ptr(mat), n, width, _ptr(lengths),

@@ -468,6 +468,24 @@ class DistributedTrainer(Trainer):
                     dropped_tail_batches=n_batches - n_rounds * window)
                 epoch_losses = []
             first_round = start_round if epoch == start_epoch else 0
+
+            # Metrics are fetched one round LATE: round r's device
+            # metrics are pulled to host while round r+1 is already
+            # queued, so the host-side batch assembly for the next round
+            # overlaps device compute instead of blocking on a sync
+            # every round (round-1 Weak #9; values and record order are
+            # identical to the eager fetch).
+            pending = None  # (device metrics of the previous round)
+
+            def drain(metrics_dev):
+                round_loss = float(
+                    np.mean(mesh_lib.fetch(metrics_dev["loss"])))
+                epoch_losses.append(round_loss)
+                self._record(
+                    round_loss=round_loss,
+                    staleness=mesh_lib.fetch(
+                        metrics_dev["staleness"]).tolist())
+
             for r in range(first_round, n_rounds):
                 perm_key, sub = jax.random.split(perm_key)
                 perm = jax.random.permutation(sub, num_workers)
@@ -489,19 +507,19 @@ class DistributedTrainer(Trainer):
                              for k, v in batch.items()}
                 ps_state, worker_states, metrics = round_jit(
                     ps_state, worker_states, batch, perm)
-                round_loss = float(
-                    np.mean(mesh_lib.fetch(metrics["loss"])))
-                epoch_losses.append(round_loss)
-                self._record(
-                    round_loss=round_loss,
-                    staleness=mesh_lib.fetch(
-                        metrics["staleness"]).tolist())
+                if pending is not None:
+                    drain(pending)
+                pending = metrics
                 every = self.checkpoint_every_rounds
                 if every and (r + 1) % every == 0 and r + 1 < n_rounds:
+                    drain(pending)
+                    pending = None
                     self._maybe_save(
                         {"ps": ps_state, "workers": worker_states,
                          "perm_key": perm_key},
                         {"epoch": epoch, "round": r + 1})
+            if pending is not None:
+                drain(pending)
             self._record(epoch_loss=float(np.mean(epoch_losses)))
             if getattr(self, "_eval_dataset", None) is not None:
                 self._eval_epoch({
@@ -798,10 +816,12 @@ class _MemberParallelTrainer(Trainer):
             vrun = jax.jit(vrun)
 
         cols = self._columns()
-        # Partition ONCE: member i sees only its own 1/n of the data for
-        # the whole run (the disjointness ensembling's variance reduction
-        # rests on); only the within-shard batch order reshuffles.
-        member_shards = dataset.repartition(n)
+        # Partition ONCE (after one global shuffle so contiguous/sorted
+        # datasets don't give members order-biased shards): member i
+        # sees only its own 1/n of the data for the whole run — the
+        # disjointness ensembling's variance reduction rests on.  Only
+        # the within-shard batch order reshuffles per epoch.
+        member_shards = dataset.shuffle(seed=self.seed).repartition(n)
         for epoch in range(self.num_epoch):
             per_member = [
                 _stack_batches(
