@@ -6,24 +6,25 @@ BiLSTM, handles sequences whole per worker).  The TPU rebuild makes
 sequence parallelism first-class: shard the time axis of ``q``/``k``/``v``
 across a mesh axis, keep the query block resident, and rotate the
 key/value blocks around the ring with ``lax.ppermute`` — one hop per
-step, N-1 hops total — accumulating exact softmax attention with the
-online (flash-style) running max / denominator.  The ICI traffic per
-step is one K/V block, which overlaps with the block's matmuls on TPU.
+scan step, N hops total (the final hop restores the original block
+placement, keeping the scan carry uniform) — accumulating exact softmax
+attention with the online (flash-style) running max / denominator.  The
+ICI traffic per step is one K/V block, which overlaps with the block's
+matmuls on TPU.
 
-Memory: the forward pass holds O(T_local) activations per device and
-never materializes a [T_local, T_global] attention matrix.  The backward
-pass is autodiff through the scan with a rematerialized body: scan
-stores only the per-step carries (the rotating K/V blocks and f32
-accumulators) and recomputes each block's logits/probabilities in the
-backward sweep, so training memory is linear in sequence length, not
-quadratic.  (A custom reverse-ring VJP that re-rotates K/V instead of
-storing per-step carries would cut the stored-carry term from
-O(T_global) to O(T_local) per device; future work.)
+Memory: O(T_local) per device in both directions.  The forward pass
+holds only the online-softmax accumulators and never materializes a
+[T_local, T_global] attention matrix; the backward pass is a custom
+reverse-ring VJP (the flash-attention backward) that saves just
+``(q, k, v, out, logsumexp)`` and recomputes each block's probabilities
+in a second ring pass, with the dK/dV accumulators traveling alongside
+their K/V blocks so each arrives home after N hops.  No per-step
+residual stacks anywhere — peak memory is independent of the ring size.
 
 This is an SPMD op: call it inside ``jax.shard_map`` (or use
 ``ring_attn_fn`` as the ``attn_fn`` of a ``TransformerLM`` whose
-``seq_axis`` names the mesh axis).  Differentiable (the backward pass is
-autodiff through ``ppermute``, i.e. the reverse ring).
+``seq_axis`` names the mesh axis).  First-order differentiable; the
+gradients are tested against dense attention (tests/test_ring_attention).
 """
 
 from __future__ import annotations
@@ -40,6 +41,124 @@ from jax import lax
 _NEG = np.float32(-1e30)
 
 
+def _ring(axis_name: str):
+    """The one-hop-backward permutation (block s lands on device s-1)."""
+    n = lax.axis_size(axis_name)
+    return n, lax.axis_index(axis_name), [(i, (i - 1) % n)
+                                          for i in range(n)]
+
+
+def _vary(axis_name, trees):
+    """Mark zero-initialized scan carries as device-varying (scan's
+    carry typing must agree with the computed, varying outputs)."""
+    return tuple(lax.pcast(x, (axis_name,), to="varying") for x in trees)
+
+
+def _block_mask(src, t_local, q_pos):
+    k_pos = src * t_local + jnp.arange(t_local)
+    return (q_pos[:, None] >= k_pos[None, :])[None, None]
+
+
+def _forward_scan(q, k, v, axis_name, scale, causal):
+    """Online-softmax ring forward.  Returns ``(out32 [B,T,H,D],
+    L [B,H,T])`` where ``L = m + log(l)`` is the per-row logsumexp the
+    backward pass needs to re-normalize recomputed probabilities."""
+    q32 = q.astype(jnp.float32)
+    b, t_local, h, d = q32.shape
+    n, me, ring = _ring(axis_name)
+    q_pos = me * t_local + jnp.arange(t_local)
+
+    def body(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = _block_mask((me + s) % n, t_local, q_pos)
+            logits = jnp.where(mask, logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            p = p * mask  # exact zeros for masked entries
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        # Rotate (the hop after the last step restores the original
+        # placement, which keeps the scan carry shape uniform).
+        k_blk = lax.ppermute(k_blk, axis_name, ring)
+        v_blk = lax.ppermute(v_blk, axis_name, ring)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    init = (k, v, *_vary(axis_name, (
+        jnp.full((b, h, t_local), _NEG, jnp.float32),
+        jnp.zeros((b, h, t_local), jnp.float32),
+        jnp.zeros((b, h, t_local, d), jnp.float32))))
+    (_, _, m, l, acc), _ = lax.scan(body, init, jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bhqd->bqhd", acc / l[..., None])
+    return out, m + jnp.log(l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_f32(q, k, v, axis_name, scale, causal):
+    out, _ = _forward_scan(q, k, v, axis_name, scale, causal)
+    return out
+
+
+def _fwd(q, k, v, axis_name, scale, causal):
+    out, lse = _forward_scan(q, k, v, axis_name, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(axis_name, scale, causal, residuals, dout):
+    """Reverse ring: the flash-attention backward, with dK/dV
+    accumulators traveling *with* their K/V blocks around the ring so
+    each returns home after N hops having collected every device's
+    contribution.  Per-device memory is O(T_local) — no per-step
+    residual stacks (the motivation for the custom VJP)."""
+    q, k, v, out, lse = residuals
+    q32 = q.astype(jnp.float32)
+    dout32 = dout.astype(jnp.float32)
+    b, t_local, h, d = q32.shape
+    n, me, ring = _ring(axis_name)
+    q_pos = me * t_local + jnp.arange(t_local)
+    # D_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
+    D = jnp.einsum("bqhd,bqhd->bhq", dout32, out.astype(jnp.float32))
+
+    def body(carry, s):
+        k_blk, v_blk, dk, dv, dq = carry
+        k32 = k_blk.astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+        if causal:
+            # mask BEFORE exp (as the forward does): a masked future-key
+            # logit can exceed lse by enough to overflow exp; relying on
+            # inf * False == 0 would pin correctness to a lowering detail
+            mask = _block_mask((me + s) % n, t_local, q_pos)
+            logits = jnp.where(mask, logits, _NEG)
+        p = jnp.exp(logits - lse[..., None])  # normalized probs
+        if causal:
+            p = p * mask  # exact zeros
+        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, dout32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dout32,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - D[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
+        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+        k_blk = lax.ppermute(k_blk, axis_name, ring)
+        v_blk = lax.ppermute(v_blk, axis_name, ring)
+        dk = lax.ppermute(dk, axis_name, ring)
+        dv = lax.ppermute(dv, axis_name, ring)
+        return (k_blk, v_blk, dk, dv, dq), None
+
+    zeros_kv = jnp.zeros((b, t_local, h, d), jnp.float32)
+    init = (k, v, *_vary(axis_name, (zeros_kv, zeros_kv, zeros_kv)))
+    (_, _, dk, dv, dq), _ = lax.scan(body, init, jnp.arange(n))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_attention_f32.defvjp(_fwd, _bwd)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, scale: float | None = None,
                    causal: bool = True) -> jax.Array:
@@ -54,56 +173,20 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
       causal: apply a causal mask in *global* positions.
 
     Returns:
-      Attention output ``[B, T_local, H, D]`` in ``q.dtype`` (accumulation
-      is always f32).
+      Attention output ``[B, T_local, H, D]`` in ``q.dtype`` (all
+      accumulation in f32).
+
+    Differentiation uses a custom reverse-ring VJP (flash backward:
+    probabilities recomputed from the saved logsumexp, dK/dV
+    accumulators riding the ring) with O(T_local) residual memory per
+    device.  First-order only — higher-order autodiff through this op
+    is not defined.
     """
-    orig_dtype = q.dtype
-    q32 = q.astype(jnp.float32)
-    b, t_local, h, d = q32.shape
     if scale is None:
-        scale = d ** -0.5
-    n = lax.axis_size(axis_name)
-    me = lax.axis_index(axis_name)
-    q_pos = me * t_local + jnp.arange(t_local)
-
-    # Each step the K/V blocks hop one device backward, so at step s this
-    # device sees the block originally on device (me + s) % n.
-    ring = [(i, (i - 1) % n) for i in range(n)]
-
-    def body(carry, s):
-        k_blk, v_blk, m, l, acc = carry
-        src = (me + s) % n
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
-                            k_blk.astype(jnp.float32)) * scale
-        if causal:
-            k_pos = src * t_local + jnp.arange(t_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None], logits, _NEG)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        if causal:
-            p = p * mask[None, None]  # exact zeros for masked entries
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        # Rotate (the hop after the last step restores the original
-        # placement, which keeps the scan carry shape uniform).
-        k_blk = lax.ppermute(k_blk, axis_name, ring)
-        v_blk = lax.ppermute(v_blk, axis_name, ring)
-        return (k_blk, v_blk, m_new, l, acc), None
-
-    # pvary: the accumulators are device-varying (they depend on this
-    # device's q block), which scan's carry typing must see from step 0.
-    init = (k, v, *map(
-        lambda x: lax.pcast(x, (axis_name,), to="varying"),
-        (jnp.full((b, h, t_local), _NEG, jnp.float32),
-         jnp.zeros((b, h, t_local), jnp.float32),
-         jnp.zeros((b, h, t_local, d), jnp.float32))))
-    (_, _, _, l, acc), _ = lax.scan(jax.checkpoint(body), init,
-                                    jnp.arange(n))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.einsum("bhqd->bqhd", out).astype(orig_dtype)
+        scale = q.shape[-1] ** -0.5
+    out = _ring_attention_f32(q, k, v, axis_name, float(scale),
+                              bool(causal))
+    return out.astype(q.dtype)
 
 
 def ring_attn_fn(axis_name: str, causal: bool = True):
