@@ -73,32 +73,34 @@ def process_shard(dataset, seed: int | None = None):
     return dataset.shard(jax.process_count(), jax.process_index())
 
 
-def global_batch_from_local(sharding: NamedSharding, local_tree):
+def global_batch_from_local(sharding, local_tree):
     """Assemble globally-sharded device arrays from host-local data.
 
-    ``local_tree`` is any pytree of arrays; every leaf gets ``sharding``.
-    Single-process: a plain sharded ``device_put``.  Multi-process: each
-    host contributes only its shard's rows (for replicated shardings,
-    the full replica) and ``jax.make_array_from_process_local_data``
-    stitches the global array — the DCN-free path for per-host data
-    loading (SURVEY.md §7 L0 "host-local data loading").
+    ``local_tree`` is any pytree of arrays; ``sharding`` is either one
+    ``NamedSharding`` applied to every leaf or a matching pytree of
+    per-leaf shardings (tensor-parallel states).  Single-process: a
+    plain sharded ``device_put``.  Multi-process: each host contributes
+    only its shard's rows (for replicated shardings, the full replica)
+    and ``jax.make_array_from_process_local_data`` stitches the global
+    array — the DCN-free path for per-host data loading (SURVEY.md §7
+    L0 "host-local data loading").
     """
+    if isinstance(sharding, jax.sharding.Sharding):
+        sharding = jax.tree_util.tree_map(lambda _: sharding, local_tree)
     if jax.process_count() == 1:
-        return jax.tree_util.tree_map(
-            lambda v: jax.device_put(v, sharding), local_tree)
+        return jax.device_put(local_tree, sharding)
 
-    def put(v):
+    def put(v, s):
         # Typed PRNG keys can't pass through numpy: ship the raw uint32
         # key data and re-wrap it on the global array.
         if hasattr(v, "dtype") and jax.dtypes.issubdtype(
                 v.dtype, jax.dtypes.prng_key):
             data = jax.make_array_from_process_local_data(
-                sharding, np.asarray(jax.random.key_data(v)))
+                s, np.asarray(jax.random.key_data(v)))
             return jax.random.wrap_key_data(data)
-        return jax.make_array_from_process_local_data(
-            sharding, np.asarray(v))
+        return jax.make_array_from_process_local_data(s, np.asarray(v))
 
-    return jax.tree_util.tree_map(put, local_tree)
+    return jax.tree_util.tree_map(put, local_tree, sharding)
 
 
 def _select_spanning_devices(devices: Sequence[jax.Device],

@@ -3,6 +3,12 @@ from distkeras_tpu.parallel.host_ps import (  # noqa: F401
     PSClient,
     PSServer,
 )
+from distkeras_tpu.parallel.tensor_parallel import (  # noqa: F401
+    TP_RULES,
+    rules_for,
+    shard_tree,
+    tree_shardings,
+)
 from distkeras_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attn_fn,

@@ -1,0 +1,132 @@
+"""Tensor parallelism: parameter sharding rules over the mesh ``model`` axis.
+
+Beyond the reference (SURVEY.md §2.3: "Tensor parallelism: NO"), because
+on TPU it is nearly free to express: pick a mesh, annotate the parameter
+shardings, and XLA/GSPMD inserts the ICI collectives (the scaling-book
+recipe).  There is no hand-written collective anywhere in this module —
+a rule maps a parameter *path* to a ``PartitionSpec`` and everything else
+is ``jax.device_put`` + ``jit``.
+
+The rules are Megatron-style for the transformer: attention Q/K/V are
+column-parallel over heads, the output projection is row-parallel, the
+MLP is column- then row-parallel, and the LM head is column-parallel
+over the vocabulary — so each block needs exactly one all-reduce in
+forward and one in backward, which GSPMD derives on its own from these
+annotations.
+
+Optimizer state needs no extra rules: Adam's ``mu``/``nu`` mirror the
+parameter tree, so their paths end in the same ``.../kernel`` suffixes
+and the same rules match (``tree_shardings`` works on any pytree —
+``TrainState`` included).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.mesh import MODEL_AXIS
+
+# A rule is (path-regex, spec) where spec is a PartitionSpec or a
+# callable (path_str, leaf) -> PartitionSpec.  First match wins; no
+# match -> replicated.
+Rule = tuple[str, Any]
+
+TRANSFORMER_TP_RULES: Sequence[Rule] = (
+    # Attention: Q/K/V column-parallel over heads [d_model, H, Dh].
+    (r"(query|key|value)/kernel$", P(None, MODEL_AXIS, None)),
+    (r"(query|key|value)/bias$", P(MODEL_AXIS, None)),
+    # Output projection row-parallel [H, Dh, d_model]; bias replicated.
+    (r"out/kernel$", P(MODEL_AXIS, None, None)),
+    # Block MLP: column- then row-parallel.
+    (r"Block_\d+/Dense_0/kernel$", P(None, MODEL_AXIS)),
+    (r"Block_\d+/Dense_0/bias$", P(MODEL_AXIS)),
+    (r"Block_\d+/Dense_1/kernel$", P(MODEL_AXIS, None)),
+    # LM head column-parallel over the vocabulary.
+    (r"lm_head/kernel$", P(None, MODEL_AXIS)),
+    (r"lm_head/bias$", P(MODEL_AXIS)),
+)
+
+
+def _alternating_dense(path: str, leaf) -> P:
+    """Even Dense layers column-parallel, odd row-parallel, so each
+    even/odd pair contracts with a single all-reduce and the elementwise
+    activation between them runs on the sharded feature axis."""
+    idx = int(re.search(r"Dense_(\d+)", path).group(1))
+    if path.endswith("kernel"):
+        return P(None, MODEL_AXIS) if idx % 2 == 0 else P(MODEL_AXIS, None)
+    return P(MODEL_AXIS) if idx % 2 == 0 else P()
+
+
+MLP_TP_RULES: Sequence[Rule] = (
+    (r"Dense_\d+/(kernel|bias)$", _alternating_dense),
+)
+
+TP_RULES: dict[str, Sequence[Rule]] = {
+    "transformer_lm": TRANSFORMER_TP_RULES,
+    "mlp": MLP_TP_RULES,
+}
+
+
+def rules_for(family: str) -> Sequence[Rule]:
+    """TP rules for a registered model family.
+
+    Families without rules (convnet/resnet/bilstm/widedeep) are
+    deliberately absent: their parameters are small enough that
+    data-parallel replication is the right layout, and annotating them
+    would only add collectives.
+    """
+    try:
+        return TP_RULES[family]
+    except KeyError:
+        raise ValueError(
+            f"no tensor-parallel rules for model family {family!r}; "
+            f"available: {sorted(TP_RULES)}. Pass explicit rules, or "
+            f"use model_parallel=1.") from None
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(getattr(entry, "name", entry)))
+    return "/".join(parts)
+
+
+def spec_for(path_str: str, leaf, rules: Sequence[Rule]) -> P:
+    """The PartitionSpec the first matching rule assigns (else ``P()``)."""
+    for pattern, spec in rules:
+        if re.search(pattern, path_str):
+            if callable(spec):
+                spec = spec(path_str, leaf)
+            ndim = getattr(leaf, "ndim", None)
+            if ndim is not None and len(spec) > ndim:
+                raise ValueError(
+                    f"rule {pattern!r} assigns rank-{len(spec)} spec "
+                    f"{spec} to rank-{ndim} leaf at {path_str!r}")
+            return spec
+    return P()
+
+
+def tree_shardings(mesh: Mesh, tree,
+                   rules: Sequence[Rule]) -> Any:
+    """``NamedSharding`` for every leaf of ``tree`` (params, a whole
+    ``TrainState``, optimizer state, ...), by path-matching ``rules``.
+    Unmatched leaves are replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, spec_for(_path_str(path), leaf, rules)),
+        tree)
+
+
+def shard_tree(mesh: Mesh, tree, rules: Sequence[Rule]):
+    """Place ``tree`` on ``mesh`` with the rules' shardings (single
+    ``jax.device_put`` per leaf; GSPMD handles everything downstream)."""
+    return jax.device_put(tree, tree_shardings(mesh, tree, rules))

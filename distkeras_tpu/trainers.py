@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distkeras_tpu import mesh as mesh_lib
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import ModelSpec
+from distkeras_tpu.parallel import tensor_parallel
 from distkeras_tpu.parallel.ps_emulator import make_round_fn
 from distkeras_tpu.parallel.update_rules import (
     AdagRule,
@@ -237,14 +238,38 @@ class SyncTrainer(Trainer):
 
     SCAN_CHUNK = 32
 
-    def __init__(self, model, num_workers: int | None = None, **kwargs):
+    def __init__(self, model, num_workers: int | None = None,
+                 model_parallel: int = 1, tp_rules=None, **kwargs):
+        """``model_parallel`` > 1 adds a tensor-parallel dimension: the
+        mesh becomes ``(workers, model)`` and parameters are sharded
+        over the ``model`` axis per ``parallel.tensor_parallel`` rules
+        (Megatron-style for ``transformer_lm``/``mlp``; pass
+        ``tp_rules`` for custom models).  Pure GSPMD — same numerics as
+        ``model_parallel=1``, XLA inserts the collectives."""
         super().__init__(model, **kwargs)
         self.num_workers = num_workers
+        self.model_parallel = int(model_parallel)
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"model_parallel must be >= 1, got {model_parallel}")
+        self.tp_rules = tp_rules
 
     def _train(self, dataset, initial_variables, resume_from=None):
         devices = jax.devices()
-        num_workers = self.num_workers or len(devices)
-        use_mesh = len(devices) >= num_workers > 1
+        mp = self.model_parallel
+        num_workers = self.num_workers or max(1, len(devices) // mp)
+        use_mesh = len(devices) >= num_workers * mp > 1
+        if mp > 1 and not use_mesh:
+            raise ValueError(
+                f"model_parallel={mp} with {num_workers} workers needs "
+                f"{num_workers * mp} devices, have {len(devices)}")
+        if mp > 1 and self.checkpoint_dir and jax.process_count() > 1:
+            # Multi-host TP state is not fully addressable; save_checkpoint
+            # would need a per-shard (orbax-style distributed) layout.
+            raise NotImplementedError(
+                "checkpointing tensor-parallel state on multi-host runs "
+                "is not supported yet; checkpoint single-host or with "
+                "model_parallel=1")
         global_batch = self.batch_size * num_workers
         # Multi-host: every process runs this same program; each holds
         # only its rows of the (identically generated) global dataset and
@@ -268,18 +293,27 @@ class SyncTrainer(Trainer):
         run_chunk = make_window_runner(step)
 
         if use_mesh:
-            m = mesh_lib.create_mesh(num_workers, devices=devices)
+            m = mesh_lib.create_mesh(num_workers, model_parallel=mp,
+                                     devices=devices)
             rep = NamedSharding(m, P())
             # [chunk, B_global, ...]: global batch axis sharded across
             # workers — both the jit contract and the host-side chunk
             # assembly below use this one sharding.
             batch_sharded = NamedSharding(
                 m, P(None, mesh_lib.WORKER_AXIS))
-            state = mesh_lib.global_batch_from_local(rep, state)
+            if mp > 1:
+                rules = (self.tp_rules if self.tp_rules is not None
+                         else tensor_parallel.rules_for(self.spec.family))
+                state_sharding = tensor_parallel.tree_shardings(
+                    m, state, rules)
+            else:
+                state_sharding = rep
+            state = mesh_lib.global_batch_from_local(state_sharding,
+                                                     state)
             run_chunk = jax.jit(
                 run_chunk,
-                in_shardings=(rep, batch_sharded),
-                out_shardings=(rep, rep))
+                in_shardings=(state_sharding, batch_sharded),
+                out_shardings=(state_sharding, rep))
         else:
             run_chunk = jax.jit(run_chunk)
 

@@ -1,0 +1,106 @@
+"""Tensor parallelism: rule->spec mapping, optimizer-state sharding
+inheritance, and TP-vs-DP training parity (same numerics, GSPMD inserts
+the collectives).  Beyond the reference (SURVEY.md §2.3: TP absent)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import ModelSpec, model_config
+from distkeras_tpu.parallel import tensor_parallel as tp
+from distkeras_tpu.trainers import SyncTrainer
+from distkeras_tpu.workers import TrainState, resolve_optimizer
+
+LM = model_config("transformer_lm", (16,), input_dtype="int32",
+                  vocab_size=32, num_layers=2, d_model=32, num_heads=4,
+                  max_len=16, dtype="float32")
+M = mesh_lib.MODEL_AXIS
+
+
+def _lm_state():
+    spec = ModelSpec.from_config(LM)
+    variables = spec.build().init(
+        jax.random.key(0), np.zeros((2, 16), np.int32))
+    return TrainState.create(variables, resolve_optimizer("adam", 1e-3),
+                             jax.random.key(1))
+
+
+def test_transformer_rules_map_expected_specs(devices):
+    mesh = mesh_lib.create_mesh(2, model_parallel=2)
+    shardings = tp.tree_shardings(mesh, _lm_state(),
+                                  tp.rules_for("transformer_lm"))
+    flat = {
+        tp._path_str(path): s.spec
+        for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]}
+
+    def spec_of(suffix):
+        hits = {k: v for k, v in flat.items() if k.endswith(suffix)
+                and k.startswith("params")}
+        assert hits, (suffix, sorted(flat))
+        specs = set(hits.values())
+        assert len(specs) == 1, hits
+        return specs.pop()
+
+    assert spec_of("query/kernel") == P(None, M, None)
+    assert spec_of("out/kernel") == P(M, None, None)
+    assert spec_of("Dense_0/kernel") == P(None, M)
+    assert spec_of("Dense_1/kernel") == P(M, None)
+    assert spec_of("lm_head/kernel") == P(None, M)
+    assert spec_of("LayerNorm_0/scale") == P()
+    assert spec_of("Embed_0/embedding") == P()
+
+
+def test_optimizer_state_inherits_param_specs(devices):
+    mesh = mesh_lib.create_mesh(2, model_parallel=2)
+    shardings = tp.tree_shardings(mesh, _lm_state(),
+                                  tp.rules_for("transformer_lm"))
+    flat = {
+        tp._path_str(path): s.spec
+        for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]}
+    # Adam mu/nu mirror the param tree: same suffix => same spec.
+    mu = {k: v for k, v in flat.items()
+          if "mu" in k and k.endswith("query/kernel")}
+    assert mu and set(mu.values()) == {P(None, M, None)}, flat
+
+
+def test_bad_model_parallel_raises():
+    with pytest.raises(ValueError, match="model_parallel"):
+        SyncTrainer(LM, model_parallel=0)
+    with pytest.raises(ValueError, match="model_parallel"):
+        SyncTrainer(LM, model_parallel=-2)
+
+
+def test_unknown_family_raises():
+    with pytest.raises(ValueError, match="no tensor-parallel rules"):
+        tp.rules_for("resnet")
+
+
+def test_rank_mismatch_raises():
+    with pytest.raises(ValueError, match="rank"):
+        tp.spec_for("query/kernel", np.zeros((4,)),
+                    tp.rules_for("transformer_lm"))
+
+
+@pytest.mark.parametrize("config,loss,data", [
+    (LM, "sparse_categorical_crossentropy",
+     datasets.lm_synth(256, seq_len=16, vocab_size=32, seed=3)),
+    (model_config("mlp", (8,), num_classes=4, hidden=(32, 32)),
+     "categorical_crossentropy",
+     datasets.synthetic_classification(256, (8,), 4, seed=3)),
+])
+def test_tp_matches_dp_training(devices, config, loss, data):
+    """model_parallel=2 must reproduce the pure-DP run: same parameters,
+    same data order, same update rule — only the layout differs."""
+    def run(mp):
+        t = SyncTrainer(config, num_workers=2, model_parallel=mp,
+                        loss=loss, worker_optimizer="adam",
+                        learning_rate=3e-3, batch_size=16, num_epoch=2)
+        t.train(data)
+        return t.history["epoch_loss"]
+
+    dp, tp_ = run(1), run(2)
+    np.testing.assert_allclose(tp_, dp, rtol=2e-4, atol=2e-5)
+    assert dp[-1] < dp[0], dp
