@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any
 
 import jax
@@ -37,6 +38,9 @@ from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 from distkeras_tpu.utils import deserialize_params, serialize_params
 
 Pytree = Any
+
+# Wire value for "no sequence number" (dedupe off) in commit frames.
+_NO_SEQ = 2 ** 64 - 1
 
 
 def _to_numpy(tree: Pytree) -> Pytree:
@@ -60,21 +64,41 @@ class HostParameterServer:
         self._pull_clock: dict[int, int] = {}
         self.staleness_log: list[int] = []
         self.num_commits = 0
+        self._last_seen: dict[int, float] = {}
+        self._last_reply: dict[int, tuple[int, Pytree]] = {}
 
     # -- the two verbs -----------------------------------------------------
 
     def pull(self, worker_id: int) -> Pytree:
         with self._lock:
             self._pull_clock[worker_id] = self._clock
+            self._last_seen[worker_id] = time.monotonic()
             return self._center
 
     def commit(self, worker_id: int, payload: Pytree,
-               local: Pytree | None = None) -> Pytree:
+               local: Pytree | None = None,
+               seq: int | None = None) -> Pytree:
         """Apply one commit; returns the worker's new local params (the
         rule's pull law, evaluated against the same center the server
         used — commit-and-pull is one atomic exchange, as in the
-        reference where the handler thread holds the connection)."""
+        reference where the handler thread holds the connection).
+
+        ``seq`` is the worker's commit sequence number (monotonic per
+        worker), used to dedupe retries: when a commit was applied but
+        its *reply* was lost (a socket dying between apply and ack),
+        the retried commit carries the same seq and gets the cached
+        reply back instead of applying the window's delta twice —
+        at-most-once application.  Any ``seq <=`` the worker's last
+        applied seq is a duplicate (a straggler handler can deliver an
+        old retransmit arbitrarily late); stragglers older than the
+        last commit get the cached latest reply, which lands on a dead
+        connection anyway."""
         with self._lock:
+            if seq is not None:
+                last = self._last_reply.get(worker_id)
+                if last is not None and seq <= last[0]:
+                    self._last_seen[worker_id] = time.monotonic()
+                    return last[1]
             staleness = self._clock - self._pull_clock.get(worker_id, 0)
             state = PSState(center=self._center,
                             clock=np.int32(self._clock))
@@ -87,22 +111,53 @@ class HostParameterServer:
             self._pull_clock[worker_id] = self._clock
             self.staleness_log.append(int(staleness))
             self.num_commits += 1
-            return _to_numpy(pulled)
+            self._last_seen[worker_id] = time.monotonic()
+            pulled = _to_numpy(pulled)
+            if seq is not None:
+                self._last_reply[worker_id] = (seq, pulled)
+            return pulled
 
     @property
     def center(self) -> Pytree:
         with self._lock:
             return self._center
 
+    def retire(self, worker_id: int) -> None:
+        """A worker finished cleanly: stop monitoring it (so
+        ``idle_workers`` never flags it) and drop its dedupe reply."""
+        with self._lock:
+            self._last_seen.pop(worker_id, None)
+            self._last_reply.pop(worker_id, None)
+
+    def clear_reply_cache(self) -> None:
+        """Drop all cached dedupe replies (a full param copy per
+        worker) — for when no client can retry anymore."""
+        with self._lock:
+            self._last_reply.clear()
+
+    def idle_workers(self, timeout: float) -> list[int]:
+        """Failure *detection* (SURVEY.md §5 row the reference left
+        empty): workers silent — no pull or commit — for more than
+        ``timeout`` seconds.  PS traffic is the natural heartbeat: an
+        alive PS-family worker contacts the server every communication
+        window; one that is silent is stalled, partitioned, or dead."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(w for w, seen in self._last_seen.items()
+                          if now - seen > timeout)
+
 
 class PSServer:
     """TCP front end for a ``HostParameterServer``.
 
     Protocol (all messages framed by ``transport``): first message on a
-    connection is the msgpack'd worker id (4-byte big-endian int).  Then
-    requests: ``b"p"`` -> center params; ``b"c" + params`` (+ optional
-    second frame with local params for pull-uses-local rules) -> new
-    local params.  ``b"s"`` shuts the server down.
+    connection is the worker id (4-byte big-endian int).  Then requests:
+    ``b"p"`` -> center params; ``b"c" + 8-byte seq + params`` (+
+    optional second frame with local params for pull-uses-local rules)
+    -> new local params, where ``seq`` is the worker's monotonic commit
+    counter (dedupes retried commits whose ack was lost; the all-ones
+    value means "no seq" and disables dedupe for that commit).  ``b"s"``
+    shuts the server down.
     """
 
     def __init__(self, ps: HostParameterServer, template: Pytree,
@@ -160,17 +215,24 @@ class PSServer:
                         transport.send_msg(conn, serialize_params(
                             self.ps.pull(worker_id)))
                     elif cmd == b"c":
+                        seq = int.from_bytes(body[:8], "big")
+                        if seq == _NO_SEQ:
+                            seq = None
                         payload = deserialize_params(self._template,
-                                                     body)
+                                                     body[8:])
                         local = None
                         if self.ps.rule.pull_uses_local:
                             local = deserialize_params(
                                 self._template,
                                 transport.recv_msg(conn))
                         pulled = self.ps.commit(worker_id, payload,
-                                                local)
+                                                local, seq=seq)
                         transport.send_msg(conn,
                                            serialize_params(pulled))
+                    elif cmd == b"d":
+                        # clean worker finish: retire from liveness
+                        # monitoring and drop its dedupe reply
+                        self.ps.retire(worker_id)
                     elif cmd == b"s":
                         self._stop.set()
                         return
@@ -181,6 +243,8 @@ class PSServer:
 
     def stop(self):
         self._stop.set()
+        # No more clients: the dedupe replies have nothing to answer.
+        self.ps.clear_reply_cache()
         try:
             self._sock.close()
         except OSError:
@@ -208,15 +272,28 @@ class PSClient:
         return deserialize_params(self._template,
                                   transport.recv_msg(self._sock))
 
-    def commit(self, payload: Pytree,
-               local: Pytree | None = None) -> Pytree:
-        transport.send_msg(self._sock, b"c",
+    def commit(self, payload: Pytree, local: Pytree | None = None,
+               seq: int | None = None) -> Pytree:
+        """``seq``: monotonic per-worker commit counter enabling
+        server-side retry dedupe; ``None`` (default) disables dedupe
+        for this commit.  Pass explicit seqs if you retry commits."""
+        wire_seq = _NO_SEQ if seq is None else int(seq)
+        if seq is not None and not 0 <= wire_seq < _NO_SEQ:
+            raise ValueError(
+                f"seq out of range [0, 2**64-1): {seq}")
+        transport.send_msg(self._sock,
+                           b"c" + wire_seq.to_bytes(8, "big"),
                            serialize_params(_to_numpy(payload)))
         if local is not None:
             transport.send_msg(self._sock,
                                serialize_params(_to_numpy(local)))
         return deserialize_params(self._template,
                                   transport.recv_msg(self._sock))
+
+    def done(self):
+        """Announce a clean finish (retires this worker from the
+        server's liveness monitoring) — call before ``close``."""
+        transport.send_msg(self._sock, b"d")
 
     def close(self):
         try:

@@ -355,13 +355,42 @@ class DistributedTrainer(Trainer):
                  communication_window: int = 5,
                  fidelity: str = "faithful",
                  transport: str = "inprocess",
-                 checkpoint_every_rounds: int | None = None, **kwargs):
+                 checkpoint_every_rounds: int | None = None,
+                 max_worker_failures: int = 0,
+                 worker_retries: int = 0,
+                 fault_injector=None, **kwargs):
+        """Elastic recovery (``fidelity='host'`` — the arm with real
+        concurrency, hence real failures; the emulated arms recover via
+        checkpoint/resume instead): a failing worker round is retried
+        up to ``worker_retries`` times — the worker re-pulls the center
+        and re-runs the window, which is exactly-once-per-commit by
+        construction (the failed window's delta never reached the
+        server; durable state lives only in the PS).  This is the
+        correct form of the retry the reference inherited from Spark,
+        which replayed a partition *against the live PS* (SURVEY.md §5
+        "semantic hazard").  A worker that exhausts its retries dies;
+        training continues if at most ``max_worker_failures`` workers
+        have died (default 0: fail fast, the round-1 behavior).
+        ``fault_injector(worker, epoch, round)`` is the chaos hook —
+        called before every round; raise from it to inject a failure
+        (SURVEY.md §5 "fault injection")."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
         self.fidelity = fidelity
         self.transport = transport
         self.checkpoint_every_rounds = checkpoint_every_rounds
+        self.max_worker_failures = int(max_worker_failures)
+        self.worker_retries = int(worker_retries)
+        self.fault_injector = fault_injector
+        if fidelity != "host" and (self.max_worker_failures
+                                   or self.worker_retries
+                                   or fault_injector is not None):
+            raise ValueError(
+                "max_worker_failures / worker_retries / fault_injector "
+                "apply only to fidelity='host' (the emulated arms are "
+                f"deterministic; recover via checkpoint/resume), got "
+                f"fidelity={fidelity!r}")
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
@@ -611,7 +640,8 @@ class DistributedTrainer(Trainer):
         cols = self._columns()
         history_lock = threading.Lock()
         round_records: list[tuple[int, int, float]] = []
-        errors: list[BaseException] = []
+        retry_records: list[tuple[int, int, int]] = []
+        failures: list[tuple[int, BaseException]] = []
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -619,8 +649,18 @@ class DistributedTrainer(Trainer):
         # copy per thread); entries are dropped after the last worker
         # fetches them.
         shard_lock = threading.Lock()
-        shard_cache: dict[int, tuple[list, int]] = {}
+        shard_cache: dict[int, tuple[list, set]] = {}
+        dead_workers: set[int] = set()
         dropped_per_epoch = [0] * self.num_epoch
+
+        def _sweep_shard_cache():
+            # caller holds shard_lock: drop entries every live worker
+            # has fetched (dead workers never will — without this, each
+            # dead worker would pin one full dataset copy per epoch)
+            for e in [e for e, (_, fetched) in shard_cache.items()
+                      if fetched | dead_workers
+                      >= set(range(num_workers))]:
+                del shard_cache[e]
 
         def epoch_shard(epoch: int, w: int):
             with shard_lock:
@@ -628,31 +668,51 @@ class DistributedTrainer(Trainer):
                     shard_cache[epoch] = (
                         dataset.shuffle(
                             seed=self.seed + 17 * epoch
-                        ).repartition(num_workers), 0)
+                        ).repartition(num_workers), set())
                 shards, fetched = shard_cache[epoch]
                 shard = shards[w]
-                if fetched + 1 == num_workers:
-                    del shard_cache[epoch]
-                else:
-                    shard_cache[epoch] = (shards, fetched + 1)
+                fetched.add(w)
+                _sweep_shard_cache()
                 return shard
 
+        def note_death(w: int):
+            with shard_lock:
+                dead_workers.add(w)
+                _sweep_shard_cache()
+
         def worker_loop(w: int):
-            try:
-                client = None
+            client = None
+
+            def connect():
+                nonlocal client
                 if server is not None:
                     client = PSClient(*server.address, worker_id=w,
                                       template=center)
-                    pull = client.pull
-                    commit = client.commit
-                else:
-                    pull = lambda: ps.pull(w)  # noqa: E731
-                    commit = lambda p, l=None: ps.commit(w, p, l)  # noqa: E731,E501
+                    return client.pull, client.commit
+                # In-process commits are atomic (apply-and-return under
+                # the lock — no lost-ack window), so no dedupe seq.
+                return (lambda: ps.pull(w),
+                        lambda p, l=None, seq=None: ps.commit(w, p, l))
 
+            try:
+                commit_seq = 0
                 state = TrainState.create(
                     {"params": center, **model_state}, tx,
                     worker_keys[w])
-                pulled = pull()
+                attempts = 0
+                while True:  # startup contact, same retry budget
+                    try:
+                        pull, commit = connect()
+                        pulled = pull()
+                        break
+                    except Exception:
+                        attempts += 1
+                        if attempts > self.worker_retries:
+                            raise
+                        if client is not None:
+                            client.close()
+                        with history_lock:
+                            retry_records.append((w, -1, -1))
                 for epoch in range(self.num_epoch):
                     stacked = _stack_batches(epoch_shard(epoch, w),
                                              self.batch_size, cols)
@@ -670,33 +730,76 @@ class DistributedTrainer(Trainer):
                         dropped_per_epoch[epoch] += (
                             n_batches - n_rounds * window)
                     for r in range(n_rounds):
-                        start_params = jax.tree_util.tree_map(
-                            jnp.asarray, pulled)
-                        state = state.replace(params=start_params)
                         batches = {
                             k: jnp.asarray(
                                 v[r * window:(r + 1) * window])
                             for k, v in stacked.items()}
-                        state, metrics = run_window(state, batches)
-                        if rule.payload_kind == "params":
-                            payload, local = state.params, state.params
-                        else:
-                            payload = rule.normalize_delta(
-                                tree_sub(state.params, start_params),
-                                window)
-                            local = None
-                        pulled = commit(
-                            payload,
-                            local if rule.pull_uses_local else None)
+                        attempts = 0
+                        reconnect = False
+                        base_state = state  # pre-round snapshot: a
+                        # retried window must not see optimizer
+                        # moments / rng / step already advanced by the
+                        # aborted attempt
+                        while True:
+                            try:
+                                if reconnect:
+                                    # inside the try: a PS still
+                                    # unreachable during recovery must
+                                    # consume retry budget, not kill
+                                    # the worker outright
+                                    if client is not None:
+                                        client.close()
+                                    pull, commit = connect()
+                                    pulled = pull()
+                                    reconnect = False
+                                if self.fault_injector is not None:
+                                    self.fault_injector(w, epoch, r)
+                                start_params = jax.tree_util.tree_map(
+                                    jnp.asarray, pulled)
+                                state = base_state.replace(
+                                    params=start_params)
+                                state, metrics = run_window(state,
+                                                            batches)
+                                if rule.payload_kind == "params":
+                                    payload = local = state.params
+                                else:
+                                    payload = rule.normalize_delta(
+                                        tree_sub(state.params,
+                                                 start_params), window)
+                                    local = None
+                                pulled = commit(
+                                    payload,
+                                    local if rule.pull_uses_local
+                                    else None, seq=commit_seq)
+                                commit_seq += 1
+                                break
+                            except Exception:
+                                # At-most-once retry: an uncommitted
+                                # window's delta never reached the PS;
+                                # one whose *ack* was lost is deduped
+                                # server-side by commit_seq.
+                                # (Exception, not BaseException:
+                                # KeyboardInterrupt/MemoryError should
+                                # not be retried.)
+                                attempts += 1
+                                if attempts > self.worker_retries:
+                                    raise
+                                reconnect = True
+                                with history_lock:
+                                    retry_records.append((w, epoch, r))
                         with history_lock:
                             round_records.append(
                                 (w, epoch,
                                  float(np.mean(
                                      np.asarray(metrics["loss"])))))
                 if client is not None:
+                    client.done()
                     client.close()
-            except BaseException as e:  # surfaced to the caller below
-                errors.append(e)
+                else:
+                    ps.retire(w)
+            except BaseException as e:  # handled by the join below
+                note_death(w)
+                failures.append((w, e))
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in range(num_workers)]
@@ -706,8 +809,17 @@ class DistributedTrainer(Trainer):
             t.join()
         if server is not None:
             server.stop()
-        if errors:
-            raise errors[0]
+        if failures and (len(failures) > self.max_worker_failures
+                         or len(failures) == num_workers):
+            raise failures[0][1]
+        if failures:
+            # Elastic continuation: the dead workers' committed rounds
+            # stay in the center (durable by construction); survivors
+            # carried the rest of the budget.
+            self._record(worker_failures=[(w, repr(e))
+                                          for w, e in failures])
+        if retry_records:
+            self._record(worker_round_retries=list(retry_records))
 
         for _, _, loss in round_records:
             self._record(round_loss=loss)

@@ -173,3 +173,156 @@ def test_ps_server_client_protocol():
                                        rtol=1e-6)
         client.close()
     assert ps.num_commits == 1
+
+
+class _Bomb(Exception):
+    pass
+
+
+def test_worker_round_retry_is_exactly_once():
+    """A transiently failing round is retried after a fresh pull; every
+    commit lands exactly once (the correct form of the Spark-retry
+    semantic hazard, SURVEY.md §5)."""
+    boom = {"armed": True}
+
+    def injector(w, epoch, r):
+        if w == 1 and epoch == 0 and r == 1 and boom.pop("armed", False):
+            raise _Bomb("transient")
+
+    t = DOWNPOUR(MLP, fidelity="host", num_workers=3,
+                 communication_window=2, batch_size=16, num_epoch=2,
+                 learning_rate=0.01, worker_optimizer="adam",
+                 worker_retries=2, fault_injector=injector)
+    t.train(DATA)
+    assert t.history["worker_round_retries"] == [[(1, 0, 1)]]
+    assert "worker_failures" not in t.history
+    # every recorded round committed exactly once
+    assert t.parameter_server_state.num_commits == \
+        len(t.history["round_loss"])
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0] * 1.05, h
+
+
+def test_dead_worker_tolerated_when_elastic():
+    """A worker that exhausts retries dies; training continues on the
+    survivors when max_worker_failures allows it."""
+    def injector(w, epoch, r):
+        if w == 2:
+            raise _Bomb("hard failure")
+
+    t = ADAG(MLP, fidelity="host", num_workers=4,
+             communication_window=2, batch_size=16, num_epoch=2,
+             learning_rate=5e-3, worker_optimizer="adam",
+             max_worker_failures=1, fault_injector=injector)
+    t.train(DATA)
+    [(dead, err)] = t.history["worker_failures"][-1]
+    assert dead == 2 and "_Bomb" in err
+    assert t.parameter_server_state.num_commits == \
+        len(t.history["round_loss"]) > 0
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0] * 1.05, h
+
+
+def test_dead_worker_fatal_by_default():
+    def injector(w, epoch, r):
+        if w == 0:
+            raise _Bomb("hard failure")
+
+    t = DOWNPOUR(MLP, fidelity="host", num_workers=2,
+                 communication_window=2, batch_size=16, num_epoch=1,
+                 learning_rate=0.01, fault_injector=injector)
+    with pytest.raises(_Bomb):
+        t.train(DATA)
+
+
+def test_all_workers_dead_raises_even_when_elastic():
+    def injector(w, epoch, r):
+        raise _Bomb("everyone")
+
+    t = DOWNPOUR(MLP, fidelity="host", num_workers=2,
+                 communication_window=2, batch_size=16, num_epoch=1,
+                 learning_rate=0.01, max_worker_failures=5,
+                 fault_injector=injector)
+    with pytest.raises(_Bomb):
+        t.train(DATA)
+
+
+def test_idle_worker_detection():
+    """The PS detects silent workers via the contact heartbeat."""
+    ps = HostParameterServer(AdagRule(), _params(0))
+    ps.pull(0)
+    ps.pull(1)
+    delta = jax.tree_util.tree_map(np.zeros_like, _params(0))
+    ps.commit(0, delta)
+    ps._last_seen[1] -= 10.0  # backdate: worker 1 went silent
+    assert ps.idle_workers(timeout=5.0) == [1]
+    assert ps.idle_workers(timeout=3600.0) == []
+
+
+def test_commit_seq_dedupes_lost_ack_retry():
+    """A retried commit with the same seq (ack lost) is not re-applied:
+    the server returns the cached reply — at-most-once application."""
+    rule = AdagRule()
+    center = _params(7)
+    ps = HostParameterServer(rule, center)
+    ps.pull(0)
+    delta = jax.tree_util.tree_map(np.ones_like, center)
+    first = ps.commit(0, delta, seq=0)
+    center_after = jax.tree_util.tree_map(np.copy, ps.center)
+    again = ps.commit(0, delta, seq=0)  # retry of the same commit
+    assert ps.num_commits == 1
+    for a, b in zip(jax.tree_util.tree_leaves(first),
+                    jax.tree_util.tree_leaves(again)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(center_after),
+                    jax.tree_util.tree_leaves(ps.center)):
+        np.testing.assert_array_equal(a, b)
+    # a new seq applies normally
+    ps.commit(0, delta, seq=1)
+    assert ps.num_commits == 2
+    # a straggler OLDER than the last applied seq is also a duplicate
+    ps.commit(0, delta, seq=0)
+    assert ps.num_commits == 2
+    # seq=None never dedupes (the in-process arm)
+    ps.commit(0, delta)
+    ps.commit(0, delta)
+    assert ps.num_commits == 4
+
+
+def test_startup_connect_failure_consumes_retry_budget():
+    """A transient failure at first contact retries instead of killing
+    the worker (recorded as epoch/round -1)."""
+    calls = {"n": 0}
+    orig_pull = HostParameterServer.pull
+
+    def flaky_pull(self, worker_id):
+        if worker_id == 1 and calls["n"] == 0:
+            calls["n"] += 1
+            raise ConnectionError("PS warming up")
+        return orig_pull(self, worker_id)
+
+    HostParameterServer.pull = flaky_pull
+    try:
+        t = DOWNPOUR(MLP, fidelity="host", num_workers=2,
+                     communication_window=2, batch_size=16, num_epoch=1,
+                     learning_rate=0.01, worker_retries=1)
+        t.train(DATA)
+    finally:
+        HostParameterServer.pull = orig_pull
+    assert (1, -1, -1) in t.history["worker_round_retries"][-1]
+    assert "worker_failures" not in t.history
+
+
+def test_retire_removes_liveness_and_reply_cache():
+    ps = HostParameterServer(AdagRule(), _params(0))
+    ps.pull(0)
+    delta = jax.tree_util.tree_map(np.zeros_like, _params(0))
+    ps.commit(0, delta, seq=0)
+    ps._last_seen[0] -= 100.0
+    assert ps.idle_workers(timeout=50.0) == [0]
+    ps.retire(0)
+    assert ps.idle_workers(timeout=0.0) == []
+    assert ps._last_reply == {}
+    # retry kwargs are host-arm only
+    with pytest.raises(ValueError, match="fidelity='host'"):
+        DOWNPOUR(MLP, worker_retries=2)
