@@ -7,7 +7,14 @@ ICI collectives) instead of Spark executors + a TCP parameter server.
 """
 
 from distkeras_tpu.version import __version__  # noqa: F401
-from distkeras_tpu import data, mesh, models, ops, parallel  # noqa: F401
+from distkeras_tpu import (  # noqa: F401
+    compat,
+    data,
+    mesh,
+    models,
+    ops,
+    parallel,
+)
 from distkeras_tpu.trainers import (  # noqa: F401
     ADAG,
     AEASGD,

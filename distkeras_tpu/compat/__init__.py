@@ -1,0 +1,5 @@
+from distkeras_tpu.compat.keras import (  # noqa: F401
+    KerasSequential,
+    from_keras,
+    from_keras_json,
+)

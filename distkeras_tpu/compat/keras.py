@@ -1,0 +1,318 @@
+"""Keras model ingestion — the reference's serialization surface.
+
+The reference's whole workflow starts from a Keras model: users build a
+``Sequential``, the framework ships ``serialize_keras_model`` output
+(architecture JSON + weight list) to workers, and every trainer returns
+a Keras model (SURVEY.md §2.1 "Utils", §3.5).  This module lets those
+users bring the same artifact here: ``from_keras_json`` parses the
+architecture JSON into a registered flax model family
+(``keras_sequential``) and maps the Keras weight list onto flax
+variables, so a reference user's model drops into any trainer /
+predictor / evaluator unchanged.
+
+Keras itself is NOT required: the JSON is parsed structurally (both the
+Keras 2 era format the reference produced and the Keras 3 one), and
+weights are plain arrays.  When Keras *is* installed, ``from_keras``
+takes a live model.
+
+Supported layers: InputLayer, Dense, Activation, Dropout, Flatten,
+Conv2D, MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D,
+Embedding, BatchNormalization.  Anything else raises with the layer
+name so the gap is visible, not silent.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.core import ModelSpec, register_model
+
+_ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": nn.relu,
+    "relu6": nn.relu6,
+    "elu": nn.elu,
+    "selu": nn.selu,
+    "gelu": nn.gelu,
+    "sigmoid": nn.sigmoid,
+    "tanh": nn.tanh,
+    "softmax": lambda x: nn.softmax(x, axis=-1),
+    "softplus": nn.softplus,
+    "swish": nn.swish,
+    "silu": nn.silu,
+    "leaky_relu": nn.leaky_relu,
+}
+
+
+def _activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"keras activation {name!r} is not supported; supported: "
+            f"{sorted(_ACTIVATIONS)}") from None
+
+
+def _pair(v) -> tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(int(x) for x in v)  # type: ignore[return-value]
+
+
+def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
+    """One keras layer config -> a minimal normalized dict (or ``None``
+    for structural no-ops).  Only the fields the forward pass needs
+    survive, so the normalized form is stable across keras versions."""
+    if class_name == "InputLayer":
+        return None
+    if class_name == "Dense":
+        return {"kind": "dense", "units": int(cfg["units"]),
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
+    if class_name == "Activation":
+        return {"kind": "activation",
+                "activation": cfg["activation"]}
+    if class_name == "Dropout":
+        return {"kind": "dropout", "rate": float(cfg["rate"])}
+    if class_name == "Flatten":
+        return {"kind": "flatten"}
+    if class_name == "Conv2D":
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise NotImplementedError(
+                "only channels_last Conv2D is supported")
+        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+            raise NotImplementedError(
+                "dilated Conv2D is not supported")
+        if int(cfg.get("groups", 1)) != 1:
+            raise NotImplementedError(
+                "grouped Conv2D is not supported")
+        return {"kind": "conv2d", "filters": int(cfg["filters"]),
+                "kernel_size": list(_pair(cfg["kernel_size"])),
+                "strides": list(_pair(cfg.get("strides", 1))),
+                "padding": str(cfg.get("padding", "valid")).upper(),
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        pool = _pair(cfg.get("pool_size", 2))
+        return {"kind": "pool",
+                "op": "max" if class_name.startswith("Max") else "avg",
+                "pool_size": list(pool),
+                "strides": list(_pair(cfg.get("strides") or pool)),
+                "padding": str(cfg.get("padding", "valid")).upper()}
+    if class_name == "GlobalAveragePooling2D":
+        return {"kind": "global_avg_pool"}
+    if class_name == "Embedding":
+        return {"kind": "embedding",
+                "input_dim": int(cfg["input_dim"]),
+                "output_dim": int(cfg["output_dim"])}
+    if class_name == "BatchNormalization":
+        if not (cfg.get("center", True) and cfg.get("scale", True)):
+            raise NotImplementedError(
+                "BatchNormalization with center=False or scale=False "
+                "is not supported (the weight mapping assumes "
+                "[gamma, beta, mean, var])")
+        axis = cfg.get("axis", -1)
+        if isinstance(axis, (list, tuple)) and len(axis) == 1:
+            axis = axis[0]
+        if axis != -1:
+            raise NotImplementedError(
+                f"BatchNormalization over axis {axis!r} is not "
+                f"supported; only the last (channels) axis is")
+        return {"kind": "batchnorm",
+                "epsilon": float(cfg.get("epsilon", 1e-3)),
+                "momentum": float(cfg.get("momentum", 0.99))}
+    raise NotImplementedError(
+        f"keras layer {class_name!r} is not supported by the "
+        f"ingestion shim (Dense/Conv2D/pooling/Embedding/BatchNorm "
+        f"stacks are); rebuild this model natively with "
+        f"distkeras_tpu.models instead")
+
+
+def _infer_input_shape(arch: Mapping[str, Any]) -> tuple[int, ...] | None:
+    """Per-sample input shape from the first layer's
+    ``batch_shape`` (keras 3) / ``batch_input_shape`` (keras 1/2),
+    when recorded."""
+    config = arch.get("config", {})
+    raw_layers = (config if isinstance(config, list)
+                  else config.get("layers", []))
+    for entry in raw_layers:
+        cfg = entry.get("config", {})
+        shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+        if shape is not None:
+            if any(d is None for d in shape[1:]):
+                return None  # variable-length dims: caller must pass one
+            return tuple(int(d) for d in shape[1:])
+    return None
+
+
+def _parse_arch(arch: Mapping[str, Any]) -> list[dict]:
+    if arch.get("class_name") != "Sequential":
+        raise NotImplementedError(
+            f"only Sequential keras models are supported, got "
+            f"{arch.get('class_name')!r} (functional graphs: rebuild "
+            f"natively with distkeras_tpu.models)")
+    config = arch.get("config", {})
+    # Keras 1 stored the layer list directly under config; 2/3 under
+    # config["layers"].
+    raw_layers = (config if isinstance(config, list)
+                  else config.get("layers", []))
+    layers = []
+    for entry in raw_layers:
+        norm = _normalize_layer(entry["class_name"],
+                                entry.get("config", {}))
+        if norm is not None:
+            layers.append(norm)
+    if not layers:
+        raise ValueError("keras architecture contains no layers")
+    return layers
+
+
+@register_model("keras_sequential")
+class KerasSequential(nn.Module):
+    """Flax twin of an ingested keras ``Sequential``.
+
+    ``layers`` is the normalized layer list ``_parse_arch`` produces —
+    plain JSON data, so specs built from keras models serialize through
+    ``ModelSpec``/checkpoints like any native family.  Parameterized
+    layers are named ``layer_{i}`` (their position in the *normalized*
+    list), which is what makes the keras weight-list mapping
+    deterministic."""
+
+    layers: Sequence[Mapping[str, Any]] = ()
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = jnp.asarray(x, dtype)
+        for i, layer in enumerate(self.layers):
+            kind = layer["kind"]
+            name = f"layer_{i}"
+            if kind == "dense":
+                # contracts the last axis, any rank — keras semantics
+                x = nn.Dense(layer["units"],
+                             use_bias=layer["use_bias"],
+                             dtype=dtype, name=name)(x)
+                x = _activation(layer["activation"])(x)
+            elif kind == "activation":
+                x = _activation(layer["activation"])(x)
+            elif kind == "dropout":
+                x = nn.Dropout(layer["rate"],
+                               deterministic=not train)(x)
+            elif kind == "flatten":
+                x = x.reshape((x.shape[0], -1))
+            elif kind == "conv2d":
+                x = nn.Conv(layer["filters"],
+                            tuple(layer["kernel_size"]),
+                            strides=tuple(layer["strides"]),
+                            padding=layer["padding"],
+                            use_bias=layer["use_bias"],
+                            dtype=dtype, name=name)(x)
+                x = _activation(layer["activation"])(x)
+            elif kind == "pool":
+                fn = nn.max_pool if layer["op"] == "max" else nn.avg_pool
+                x = fn(x, tuple(layer["pool_size"]),
+                       strides=tuple(layer["strides"]),
+                       padding=layer["padding"])
+            elif kind == "global_avg_pool":
+                x = x.mean(axis=(1, 2))
+            elif kind == "embedding":
+                x = nn.Embed(layer["input_dim"], layer["output_dim"],
+                             dtype=dtype, name=name)(
+                                 x.astype(jnp.int32))
+            elif kind == "batchnorm":
+                x = nn.BatchNorm(use_running_average=not train,
+                                 epsilon=layer["epsilon"],
+                                 momentum=layer["momentum"],
+                                 dtype=dtype, name=name)(x)
+            else:  # unreachable: _normalize_layer gates kinds
+                raise AssertionError(kind)
+        return x
+
+
+def _map_weights(layers: Sequence[Mapping[str, Any]],
+                 weights: Sequence[np.ndarray]) -> dict:
+    """Keras ``get_weights()`` order -> flax variables.
+
+    Keras lists each layer's arrays in creation order: Dense/Conv
+    ``[kernel, bias]`` (kernels already HWIO / in-out, matching flax),
+    Embedding ``[table]``, BatchNorm ``[gamma, beta, moving_mean,
+    moving_var]``."""
+    weights = [np.asarray(w) for w in weights]
+    params: dict[str, Any] = {}
+    batch_stats: dict[str, Any] = {}
+    pos = 0
+
+    def take() -> np.ndarray:
+        nonlocal pos
+        if pos >= len(weights):
+            raise ValueError(
+                f"keras weight list exhausted at array {pos}; the "
+                f"architecture expects more arrays than provided")
+        w = weights[pos]
+        pos += 1
+        return w
+
+    for i, layer in enumerate(layers):
+        kind, name = layer["kind"], f"layer_{i}"
+        if kind in ("dense", "conv2d"):
+            entry = {"kernel": take()}
+            if layer["use_bias"]:
+                entry["bias"] = take()
+            params[name] = entry
+        elif kind == "embedding":
+            params[name] = {"embedding": take()}
+        elif kind == "batchnorm":
+            params[name] = {"scale": take(), "bias": take()}
+            batch_stats[name] = {"mean": take(), "var": take()}
+    if pos != len(weights):
+        raise ValueError(
+            f"keras weight list has {len(weights)} arrays but the "
+            f"architecture consumes {pos}")
+    variables: dict[str, Any] = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    return variables
+
+
+def from_keras_json(arch_json: str,
+                    weights: Sequence[np.ndarray] | None = None,
+                    input_shape: Sequence[int] | None = None,
+                    dtype: str = "float32"):
+    """Ingest ``model.to_json()`` (+ optional ``model.get_weights()``).
+
+    Returns ``(spec, variables)`` — a ``ModelSpec`` of family
+    ``keras_sequential`` usable with every trainer, and the mapped flax
+    variables (``None`` when no weights were given; pass the variables
+    as ``initial_variables=`` to continue training, or to a predictor /
+    evaluator directly).  ``input_shape`` (per-sample, no batch dim) is
+    required only when the JSON does not record one."""
+    arch = json.loads(arch_json)
+    layers = _parse_arch(arch)
+    if input_shape is None:
+        input_shape = _infer_input_shape(arch)
+        if input_shape is None:
+            raise ValueError(
+                "the keras JSON records no input shape (the model was "
+                "never built); pass input_shape=")
+    input_dtype = ("int32" if layers[0]["kind"] == "embedding"
+                   else "float32")
+    spec = ModelSpec(family="keras_sequential",
+                     kwargs={"layers": tuple(layers), "dtype": dtype},
+                     input_shape=tuple(int(d) for d in input_shape),
+                     input_dtype=input_dtype)
+    variables = (None if weights is None
+                 else _map_weights(layers, weights))
+    return spec, variables
+
+
+def from_keras(model, dtype: str = "float32"):
+    """Ingest a live keras model: ``from_keras_json(model.to_json(),
+    model.get_weights())``."""
+    return from_keras_json(model.to_json(), model.get_weights(),
+                           dtype=dtype)

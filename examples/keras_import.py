@@ -1,0 +1,96 @@
+"""Bring a Keras model: ingest, fine-tune distributed, evaluate.
+
+The reference's entry artifact is a Keras model — users hand
+``serialize_keras_model`` output to every trainer (SURVEY.md §3.5).
+This pipeline does the same migration here: build (or load) a Keras
+``Sequential``, ingest it with ``distkeras_tpu.compat.from_keras`` into
+a flax model + mapped weights, continue training it with a distributed
+trainer, and evaluate.  When keras is not installed the same
+architecture JSON is ingested from a string — the shim needs no keras.
+
+Run:  python examples/keras_import.py
+      python examples/keras_import.py --trainer adag --devices 8
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+TRAINERS = ("single", "sync", "downpour", "adag")
+
+# The MNIST-notebook MLP, as the reference's users would have written it
+# (used when keras is not installed; identical to the keras path's arch).
+_FALLBACK_ARCH = {
+    "class_name": "Sequential",
+    "config": {"layers": [
+        {"class_name": "InputLayer",
+         "config": {"batch_shape": [None, 28, 28, 1]}},
+        {"class_name": "Flatten", "config": {}},
+        {"class_name": "Dense",
+         "config": {"units": 64, "activation": "relu"}},
+        {"class_name": "Dense",
+         "config": {"units": 10, "activation": "linear"}},
+    ]},
+}
+
+
+def main():
+    parser = make_parser(__doc__, rows=4096, epochs=3, batch_size=64,
+                         learning_rate=3e-3)
+    parser.add_argument("--trainer", choices=TRAINERS, default="sync")
+    args = parse_args_and_setup(parser)
+
+    from distkeras_tpu import trainers
+    from distkeras_tpu.compat import from_keras, from_keras_json
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+
+    try:
+        import keras
+    except ImportError:
+        keras = None
+    if keras is not None:
+        model = keras.Sequential([
+            keras.layers.Input((28, 28, 1)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(64, activation="relu"),
+            keras.layers.Dense(10),
+        ])
+        spec, variables = from_keras(model)
+        source = f"keras {keras.__version__}"
+    else:
+        spec, variables = from_keras_json(json.dumps(_FALLBACK_ARCH))
+        source = "architecture JSON (keras not installed)"
+    print(f"[keras_import] ingested from {source}: "
+          f"{[l['kind'] for l in spec.kwargs['layers']]}")
+
+    data = datasets.mnist_synth(args.rows, seed=args.seed)
+    holdout, train = data.shard(4, 0), data.shard(4, 1).concat(
+        data.shard(4, 2)).concat(data.shard(4, 3))
+
+    common = dict(loss="categorical_crossentropy",
+                  worker_optimizer="adam",
+                  learning_rate=args.learning_rate,
+                  batch_size=args.batch_size, num_epoch=args.epochs,
+                  seed=args.seed)
+    if args.trainer == "single":
+        t = trainers.SingleTrainer(spec.to_config(), **common)
+    elif args.trainer == "sync":
+        t = trainers.SyncTrainer(spec.to_config(),
+                                 num_workers=args.workers, **common)
+    else:
+        cls = {"downpour": trainers.DOWNPOUR, "adag": trainers.ADAG}
+        t = cls[args.trainer](spec.to_config(),
+                              num_workers=args.workers,
+                              communication_window=args.window,
+                              **common)
+    t.train(train, initial_variables=variables)
+    metrics = evaluate_model(t.model, t.trained_variables, holdout)
+    report(f"keras_import/{args.trainer}", t, metrics)
+
+
+if __name__ == "__main__":
+    main()

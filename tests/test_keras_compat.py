@@ -1,0 +1,168 @@
+"""Keras ingestion shim: forward parity against real keras models,
+weight-list mapping, trainer integration, and clear unsupported-layer
+errors (reference surface: serialize_keras_model / deserialize_keras_model,
+SURVEY.md §2.1 Utils + §3.5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu.compat import from_keras, from_keras_json
+from distkeras_tpu.data import datasets
+from distkeras_tpu.trainers import SingleTrainer
+
+keras = pytest.importorskip("keras")
+
+
+def _keras_mlp():
+    m = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dropout(0.0),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    return m
+
+
+def _keras_convnet():
+    return keras.Sequential([
+        keras.layers.Input((12, 12, 3)),
+        keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(8, 3, strides=2, padding="valid"),
+        keras.layers.Activation("relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5),
+    ])
+
+
+@pytest.mark.parametrize("maker,shape", [
+    (_keras_mlp, (8,)),
+    (_keras_convnet, (12, 12, 3)),
+])
+def test_forward_parity_with_keras(maker, shape):
+    m = maker()
+    spec, variables = from_keras(m)
+    assert spec.input_shape == shape
+    x = np.random.default_rng(0).normal(size=(4, *shape)).astype(
+        np.float32)
+    want = np.asarray(m(x))
+    got = np.asarray(spec.build().apply(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ingested_model_trains():
+    spec, variables = from_keras(_keras_mlp())
+    data = datasets.synthetic_classification(512, (8,), 4, seed=1)
+    t = SingleTrainer(spec.to_config(), worker_optimizer="adam",
+                      learning_rate=3e-3, batch_size=32, num_epoch=3,
+                      loss="categorical_crossentropy")
+    t.train(data, initial_variables=variables)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0], h
+
+
+def test_spec_survives_json_roundtrip():
+    spec, _ = from_keras(_keras_mlp())
+    rebuilt = json.loads(json.dumps(spec.to_config()))
+    from distkeras_tpu.models import ModelSpec
+
+    spec2 = ModelSpec.from_config(rebuilt)
+    x = np.zeros((2, 8), np.float32)
+    v = spec2.build().init(jax.random.key(0), x)
+    assert spec2.build().apply(v, x).shape == (2, 4)
+
+
+def test_batchnorm_and_embedding_mapping():
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8),
+        keras.layers.BatchNormalization(),
+        keras.layers.Activation("relu"),
+        keras.layers.Dense(3),
+    ])
+    spec, variables = from_keras(m)
+    assert "batch_stats" in variables
+    x = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+    want = np.asarray(m(x, training=False))
+    got = np.asarray(spec.build().apply(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_unsupported_layer_raises_by_name():
+    arch = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "LSTM", "config": {"units": 8}}]}}
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        from_keras_json(json.dumps(arch), input_shape=(5, 3))
+
+
+def test_functional_model_raises():
+    arch = {"class_name": "Functional", "config": {}}
+    with pytest.raises(NotImplementedError, match="Sequential"):
+        from_keras_json(json.dumps(arch), input_shape=(4,))
+
+
+def test_weight_count_mismatch_raises():
+    m = _keras_mlp()
+    too_few = m.get_weights()[:-1]
+    with pytest.raises(ValueError, match="weight list"):
+        from_keras_json(m.to_json(), too_few)
+    too_many = m.get_weights() + [np.zeros(3, np.float32)]
+    with pytest.raises(ValueError, match="weight list"):
+        from_keras_json(m.to_json(), too_many)
+
+
+def test_embedding_dense_rank3_parity():
+    """Dense applies to the last axis of rank-n input, as in keras."""
+    m = keras.Sequential([
+        keras.layers.Input((7,)),
+        keras.layers.Embedding(20, 6),
+        keras.layers.Dense(3, activation="tanh"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    spec, variables = from_keras(m)
+    assert spec.input_dtype == "int32"
+    x = np.random.default_rng(2).integers(0, 20, size=(5, 7))
+    want = np.asarray(m(x))
+    got = np.asarray(spec.build().apply(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_unsupported_options_raise_clearly():
+    base = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "InputLayer",
+         "config": {"batch_shape": [None, 8, 8, 4]}},
+        None]}}
+
+    def arch(layer):
+        import copy
+
+        a = copy.deepcopy(base)
+        a["config"]["layers"][1] = layer
+        return json.dumps(a)
+
+    with pytest.raises(NotImplementedError, match="grouped"):
+        from_keras_json(arch({"class_name": "Conv2D", "config": {
+            "filters": 8, "kernel_size": 3, "groups": 2}}))
+    with pytest.raises(NotImplementedError, match="scale=False"):
+        from_keras_json(arch({"class_name": "BatchNormalization",
+                              "config": {"scale": False}}))
+    with pytest.raises(NotImplementedError, match="axis"):
+        from_keras_json(arch({"class_name": "BatchNormalization",
+                              "config": {"axis": 1}}))
+
+
+def test_variable_length_input_needs_explicit_shape():
+    arch = {"class_name": "Sequential", "config": {"layers": [
+        {"class_name": "InputLayer",
+         "config": {"batch_shape": [None, None]}},
+        {"class_name": "Embedding",
+         "config": {"input_dim": 10, "output_dim": 4}}]}}
+    with pytest.raises(ValueError, match="input_shape"):
+        from_keras_json(json.dumps(arch))
+    spec, _ = from_keras_json(json.dumps(arch), input_shape=(12,))
+    assert spec.input_shape == (12,)
