@@ -1,0 +1,83 @@
+"""Streaming inference — the reference's Kafka demo, TPU-shaped.
+
+The reference shipped a Kafka notebook that consumed an event stream and
+ran ``model.predict`` per message batch (SURVEY.md §2.1 Examples:
+"Kafka streaming demo").  The TPU-native concern is different from the
+Spark one: a stream hands you ragged micro-batches, and every new batch
+shape costs a fresh XLA compile.  ``StreamingPredictor`` therefore runs
+ONE compiled forward at a fixed ``[batch_size, ...]`` shape: rows are
+buffered to micro-batches, the tail is padded up to the compiled shape
+and stripped after, so a long-running stream never recompiles.
+
+Sources are plain Python iterables (a Kafka/PubSub consumer loop, a
+socket reader, a generator), so there is no broker dependency; each
+yielded item is one row dict (the reference's message-with-features).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.predictors import ModelPredictor
+from distkeras_tpu.utils import pad_to_multiple
+
+
+class StreamingPredictor(ModelPredictor):
+    """Micro-batching streaming front end over the sharded predictor.
+
+    ``predict_stream(rows)`` consumes an iterable of row dicts and
+    yields the same rows with the prediction column appended, in input
+    order.  Rows are flushed to the device every ``batch_size`` rows
+    (one compiled shape — padded tail included), or immediately at
+    end-of-stream.  ``flush_every`` bounds latency for trickling
+    sources: a buffer older than that many consumed rows is flushed
+    even if not full.
+    """
+
+    def __init__(self, model, variables: Mapping, *,
+                 batch_size: int = 64, flush_every: int | None = None,
+                 **kwargs):
+        if "num_shards" in kwargs:
+            raise TypeError(
+                "StreamingPredictor feeds one device call at a time "
+                "(num_shards is fixed to 1); use ModelPredictor for "
+                "sharded offline batches")
+        # Streams feed one device call at a time; keep the compiled
+        # shape the micro-batch (no cross-shard chunking).
+        super().__init__(model, variables, batch_size=batch_size,
+                         num_shards=1, **kwargs)
+        self.flush_every = flush_every
+
+    def _flush(self, rows: list[Mapping[str, Any]]
+               ) -> Iterator[Mapping[str, Any]]:
+        x = np.stack([np.asarray(r[self.features_col]) for r in rows])
+        n = len(x)
+        x = pad_to_multiple(x, self.batch_size, axis=0)
+        pred = np.asarray(self._forward(self.variables,
+                                        jnp.asarray(x)))[:n]
+        for row, p in zip(rows, pred):
+            yield {**row, self.output_col: p}
+
+    def predict_stream(self, rows: Iterable[Mapping[str, Any]]
+                       ) -> Iterator[Mapping[str, Any]]:
+        flush_at = (self.batch_size if self.flush_every is None
+                    else min(self.batch_size, self.flush_every))
+        buf: list[Mapping[str, Any]] = []
+        for row in rows:
+            buf.append(row)
+            if len(buf) >= flush_at:
+                yield from self._flush(buf)
+                buf = []
+        if buf:
+            yield from self._flush(buf)
+
+    def __call__(self, rows):
+        """Dataset -> batch predict (the parent's pipeline contract);
+        any other iterable -> predict_stream."""
+        if isinstance(rows, Dataset):
+            return self.predict(rows)
+        return self.predict_stream(rows)

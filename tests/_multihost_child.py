@@ -42,6 +42,15 @@ def main():
                         learning_rate=0.05)
     small.train(data)
 
+    # Tensor parallelism across hosts: (4 workers, 2 model) mesh, the
+    # param/optimizer state sharded by the Megatron rules and assembled
+    # per-process through the sharding-tree path of
+    # global_batch_from_local.  Same config as `small` except layout,
+    # so the losses must agree.
+    tp = SyncTrainer(cfg, num_workers=4, model_parallel=2, batch_size=8,
+                     num_epoch=1, learning_rate=0.05)
+    tp.train(data)
+
     print(json.dumps({
         "process": jax.process_index(),
         "sync_epoch_loss": [round(x, 6)
@@ -51,6 +60,8 @@ def main():
         "adag_staleness": adag.history["staleness"][-1],
         "small_sync_loss": [round(x, 6)
                             for x in small.history["epoch_loss"]],
+        "tp_sync_loss": [round(x, 6)
+                         for x in tp.history["epoch_loss"]],
     }))
 
 

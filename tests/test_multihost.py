@@ -59,6 +59,11 @@ def test_two_process_cluster_trains_and_agrees(num_processes):
     assert a["sync_epoch_loss"] == b["sync_epoch_loss"]
     assert a["adag_round_loss"] == b["adag_round_loss"]
     assert a["small_sync_loss"] == b["small_sync_loss"]
+    assert a["tp_sync_loss"] == b["tp_sync_loss"]
+    # TP is a layout change, not an algorithm change: same losses as
+    # the dp-only run of the same configuration
+    np.testing.assert_allclose(a["tp_sync_loss"], a["small_sync_loss"],
+                               rtol=2e-4, atol=2e-5)
     # and real training signal
     sync = a["sync_epoch_loss"]
     assert sync[-1] < sync[0], sync
