@@ -327,7 +327,7 @@ class SyncTrainer(Trainer):
                     f"dataset smaller than one global batch "
                     f"({global_batch})")
             n = len(next(iter(stacked.values())))
-            losses = []
+            pending = []
             for lo in range(0, n, self.SCAN_CHUNK):
                 local = {k: v[lo:lo + self.SCAN_CHUNK]
                          for k, v in stacked.items()}
@@ -337,7 +337,10 @@ class SyncTrainer(Trainer):
                 else:
                     chunk = {k: jnp.asarray(v) for k, v in local.items()}
                 state, metrics = run_chunk(state, chunk)
-                losses.append(mesh_lib.fetch(metrics["loss"]))
+                # keep the device handle; fetching here would block
+                # next chunk's host assembly behind device compute
+                pending.append(metrics["loss"])
+            losses = [mesh_lib.fetch(x) for x in pending]
             self._record(epoch_loss=float(np.concatenate(losses).mean()))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
