@@ -3,6 +3,13 @@ from distkeras_tpu.parallel.host_ps import (  # noqa: F401
     PSClient,
     PSServer,
 )
+from distkeras_tpu.parallel.moe import (  # noqa: F401
+    MoEAux,
+    MoEParams,
+    init_moe_params,
+    moe_apply,
+)
+from distkeras_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from distkeras_tpu.parallel.tensor_parallel import (  # noqa: F401
     TP_RULES,
     rules_for,

@@ -1,0 +1,148 @@
+"""Expert parallelism: a Switch-style top-1 MoE layer over a mesh axis.
+
+Beyond the reference (SURVEY.md §2.3: "Expert parallelism: NO") —
+the last of the five parallelism forms (dp/tp/sp/pp/ep).  Experts'
+FFN parameters are sharded over the ``expert`` mesh axis; tokens are
+routed with the einsum dispatch/combine formulation (Shazeer et al.'s
+Mesh-TF Switch layout) and exchanged with ``lax.all_to_all`` over ICI:
+
+1. router: per-token logits over all E experts, top-1 gate;
+2. dispatch einsum builds ``[E, C, d]`` capacity-bucketed inputs;
+3. ``all_to_all`` turns token-sharding into expert-sharding — each
+   device receives ITS experts' buckets from every device;
+4. the local experts' FFNs run (vmapped);
+5. a reverse ``all_to_all`` + combine einsum returns gated outputs to
+   the tokens' home devices.
+
+Tokens over a full expert's capacity ``C = ceil(T_local/E *
+capacity_factor)`` are dropped (standard Switch behavior; the gate
+residual keeps training stable) and reported via the aux outputs,
+along with the load-balancing auxiliary loss from the Switch paper.
+
+SPMD: call inside ``jax.shard_map`` with tokens sharded over
+``axis_name`` and ``params`` sharded on their leading (expert) axis.
+Differentiable end to end (autodiff reverses the all_to_alls).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    """``router``: [d, E] (replicated).  ``w_in``: [E_local, d, h],
+    ``b_in``: [E_local, h], ``w_out``: [E_local, h, d], ``b_out``:
+    [E_local, d] — leading axis sharded over the expert mesh axis."""
+
+    router: jax.Array
+    w_in: jax.Array
+    b_in: jax.Array
+    w_out: jax.Array
+    b_out: jax.Array
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_hidden: int,
+                    num_experts: int) -> MoEParams:
+    """Global (unsharded) parameters; shard leading expert axes over
+    the mesh axis when placing them."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s_in = 1.0 / math.sqrt(d_model)
+    s_out = 1.0 / math.sqrt(d_hidden)
+    return MoEParams(
+        router=jax.random.normal(k1, (d_model, num_experts)) * s_in,
+        w_in=jax.random.normal(
+            k2, (num_experts, d_model, d_hidden)) * s_in,
+        b_in=jnp.zeros((num_experts, d_hidden)),
+        w_out=jax.random.normal(
+            k3, (num_experts, d_hidden, d_model)) * s_out,
+        b_out=jnp.zeros((num_experts, d_model)),
+    )
+
+
+class MoEAux(NamedTuple):
+    load_balance_loss: jax.Array  # scalar; add (scaled) to the loss
+    dropped_fraction: jax.Array   # scalar in [0, 1]
+
+
+def _routing(x, router, num_experts, capacity):
+    """Top-1 dispatch/combine tensors ([T, E, C]) + aux telemetry.
+
+    All bookkeeping runs in f32 regardless of ``x.dtype``: bf16 cumsum
+    loses integer exactness past 256, which would assign two tokens the
+    same capacity slot and silently merge their embeddings.  Only the
+    final dispatch/combine tensors are cast back."""
+    t = x.shape[0]
+    logits = (x.astype(jnp.float32)
+              @ router.astype(jnp.float32))      # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)                    # [T]
+    idx = probs.argmax(axis=-1)                  # [T]
+    mask = jax.nn.one_hot(idx, num_experts,
+                          dtype=jnp.float32)     # [T, E]
+    # position of each token within its expert's bucket
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask
+    keep = (pos < capacity).astype(jnp.float32) * mask
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity,
+        dtype=jnp.float32)                       # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * sum_e( frac_tokens_e * mean_prob_e )
+    frac = mask.mean(axis=0)
+    lb = num_experts * jnp.sum(frac * probs.mean(axis=0))
+    dropped = jnp.clip(1.0 - keep.sum() / t, 0.0, 1.0)  # f32 rounding
+    return (dispatch.astype(x.dtype), combine.astype(x.dtype),
+            MoEAux(lb, dropped))
+
+
+def moe_apply(params: MoEParams, x: jax.Array, *, axis_name: str,
+              capacity_factor: float = 1.25
+              ) -> tuple[jax.Array, MoEAux]:
+    """Apply the expert-parallel MoE FFN to ``x`` ``[T_local, d]``.
+
+    ``params`` leaves other than ``router`` carry this device's
+    ``E_local = E / n_devices`` experts.  Returns ``([T_local, d],
+    MoEAux)``; aux values are means over the mesh axis.
+    """
+    n_dev = lax.axis_size(axis_name)
+    e_local = params.w_in.shape[0]
+    num_experts = e_local * n_dev
+    t_local, d = x.shape
+    capacity = max(1, math.ceil(
+        t_local * capacity_factor / num_experts))
+
+    dispatch, combine, aux = _routing(x, params.router, num_experts,
+                                      capacity)
+
+    # [T, E, C] -> expert-major input buckets [E, C, d]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    # Token-sharded -> expert-sharded: split the (global) expert axis
+    # across devices, concatenate the senders' buckets on a new axis.
+    # [E, C, d] -> [n_dev(senders), E_local, C, d]
+    expert_in = expert_in.reshape(n_dev, e_local, capacity, d)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+    # merge sender x capacity: [E_local, n_dev * C, d]
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+        e_local, n_dev * capacity, d)
+
+    def ffn(w_in, b_in, w_out, b_out, h):
+        return jax.nn.relu(h @ w_in + b_in) @ w_out + b_out
+
+    expert_out = jax.vmap(ffn)(params.w_in, params.b_in, params.w_out,
+                               params.b_out, expert_in)
+
+    # Back to token-sharding: inverse reshape + all_to_all.
+    expert_out = expert_out.reshape(
+        e_local, n_dev, capacity, d).transpose(1, 0, 2, 3)
+    expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)
+    expert_out = expert_out.reshape(num_experts, capacity, d)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out, MoEAux(
+        lax.pmean(aux.load_balance_loss, axis_name),
+        lax.pmean(aux.dropped_fraction, axis_name))

@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe-style microbatching over a mesh axis.
+
+Beyond the reference (SURVEY.md §2.3: "Pipeline parallelism: NO"),
+completing the parallelism set (dp / tp / sp / pp / ep) the TPU mesh
+makes cheap to express.  Each device on the ``stage`` axis holds ONE
+stage's parameters (a homogeneous stack sharded on its leading axis);
+activations flow stage-to-stage over ICI with ``lax.ppermute``, one hop
+per tick, while microbatches stream in behind each other — the classic
+fill-drain (GPipe) schedule with bubble fraction
+``(S-1) / (M + S - 1)`` for ``S`` stages and ``M`` microbatches.
+
+This is an SPMD program: every device runs the same tick loop
+(``lax.scan``), computing its stage on whatever microbatch currently
+occupies it.  Differentiable — autodiff through ``ppermute`` reverses
+the ring, so the backward pass is the same pipeline running backwards;
+no custom VJP is needed.
+
+Composition: the stage axis composes with the data-parallel axis in the
+same mesh (see ``__graft_entry__._dryrun_pipeline_parallel``: a
+``(workers, stage)`` mesh with the batch sharded over ``workers``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, *,
+                   axis_name: str, num_microbatches: int) -> jax.Array:
+    """Run ``x`` through S pipelined stages under ``shard_map``.
+
+    Args:
+      stage_fn: ``(params_one_stage, activation [mb, ...]) ->
+        activation [mb, ...]`` — one stage's compute.  Activations must
+        keep one shape across stages (homogeneous pipeline).
+      stage_params: this device's slice of the stacked stage parameters
+        (call under ``shard_map`` with the stack's leading axis sharded
+        over ``axis_name``; the leading axis of each leaf here is 1 and
+        is squeezed).
+      x: this device's copy of the full local batch ``[B, ...]``;
+        ``B`` must divide into ``num_microbatches``.
+      axis_name: the mesh axis whose size is the number of stages.
+      num_microbatches: GPipe microbatch count ``M``; larger M shrinks
+        the bubble, smaller M shrinks activation working memory.
+
+    Returns:
+      ``[B, ...]`` outputs of the final stage, valid on EVERY device
+      (the last stage's results are broadcast with ``psum`` so the
+      caller can compute a loss without caring about stage placement).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[:1] != (1,):
+            raise ValueError(
+                f"stage_params leaves must arrive with a local leading "
+                f"axis of 1 (one stage per device — shard the stack's "
+                f"leading axis over {axis_name!r}); got leading axis "
+                f"{leaf.shape[0]} for a {n_stages}-stage pipeline")
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible into {num_microbatches} "
+            f"microbatches")
+    mb = b // num_microbatches
+    micro = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    n_ticks = num_microbatches + n_stages - 1
+    # Ring: stage s sends its output forward to stage s+1 each tick.
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # Device-varying zeros from tick 0 (scan's carry typing must agree
+    # with the computed, varying outputs).
+    state0 = lax.pcast(jnp.zeros_like(micro[0]), (axis_name,),
+                       to="varying")
+    out0 = lax.pcast(jnp.zeros_like(micro), (axis_name,), to="varying")
+    # The tick loop: stage 0 ingests microbatch t (while t < M), every
+    # stage applies its compute, results hop one stage forward, and the
+    # last stage banks microbatch t - (S-1) once the pipe has filled.
+
+    def tick(carry, t):
+        state, outs = carry
+        feed = micro[jnp.minimum(t, num_microbatches - 1)]
+        state = jnp.where(stage == 0, feed, state)
+        y = stage_fn(params, state)
+        done = t - (n_stages - 1)
+        outs = jnp.where(
+            (stage == n_stages - 1) & (done >= 0),
+            outs.at[jnp.maximum(done, 0)].set(y), outs)
+        state = lax.ppermute(y, axis_name, fwd)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(tick, (state0, out0), jnp.arange(n_ticks))
+    # Only the last stage holds real outputs; broadcast them.
+    outs = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+    outs = lax.psum(outs, axis_name)
+    return outs.reshape((b,) + outs.shape[2:])
