@@ -8,6 +8,7 @@ from distkeras_tpu.parallel.moe import (  # noqa: F401
     MoEParams,
     init_moe_params,
     moe_apply,
+    moe_pspecs,
 )
 from distkeras_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
 from distkeras_tpu.parallel.tensor_parallel import (  # noqa: F401

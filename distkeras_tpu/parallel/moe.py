@@ -64,6 +64,15 @@ def init_moe_params(rng: jax.Array, d_model: int, d_hidden: int,
     )
 
 
+def moe_pspecs(axis: str = "expert") -> "MoEParams":
+    """The ``shard_map`` in_specs for ``MoEParams``: router replicated,
+    every expert stack sharded on its leading axis.  One definition so
+    call sites can't drift from the field order."""
+    from jax.sharding import PartitionSpec as P
+
+    return MoEParams(P(), P(axis), P(axis), P(axis), P(axis))
+
+
 class MoEAux(NamedTuple):
     load_balance_loss: jax.Array  # scalar; add (scaled) to the loss
     dropped_fraction: jax.Array   # scalar in [0, 1]

@@ -59,8 +59,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, *,
             raise ValueError(
                 f"stage_params leaves must arrive with a local leading "
                 f"axis of 1 (one stage per device — shard the stack's "
-                f"leading axis over {axis_name!r}); got leading axis "
-                f"{leaf.shape[0]} for a {n_stages}-stage pipeline")
+                f"leading axis over {axis_name!r}); got shape "
+                f"{leaf.shape} for a {n_stages}-stage pipeline")
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
 
     b = x.shape[0]

@@ -6,7 +6,6 @@ capability)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distkeras_tpu.parallel.moe import (
@@ -14,6 +13,7 @@ from distkeras_tpu.parallel.moe import (
     MoEParams,
     init_moe_params,
     moe_apply,
+    moe_pspecs,
 )
 
 D, H, E = 8, 16, 8  # d_model, hidden, experts
@@ -46,8 +46,7 @@ def _ep_apply(mesh, params, x, capacity_factor):
 
     return jax.jit(jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(MoEParams(P(), P("expert"), P("expert"),
-                            P("expert"), P("expert")), P("expert")),
+        in_specs=(moe_pspecs("expert"), P("expert")),
         out_specs=(P("expert"), MoEAux(P(), P()))))(params, x)
 
 
@@ -102,8 +101,7 @@ def test_moe_trains(devices):
 
     sharded = jax.shard_map(
         loss_fn, mesh=mesh,
-        in_specs=(MoEParams(P(), P("expert"), P("expert"),
-                            P("expert"), P("expert")), P("expert"),
+        in_specs=(moe_pspecs("expert"), P("expert"),
                   P("expert")),
         out_specs=P())
 
