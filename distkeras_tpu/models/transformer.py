@@ -89,6 +89,9 @@ class TransformerLM(nn.Module):
     dtype: str = "bfloat16"
     attn_fn: Optional[AttnFn] = None  # None -> dense causal / ring
     seq_axis: Optional[str] = None
+    # within-device q block length for ring attention (None = full
+    # block); see parallel.ring_attention.ring_attention(q_chunk=)
+    attn_q_chunk: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -105,7 +108,8 @@ class TransformerLM(nn.Module):
             positions = (lax.axis_index(self.seq_axis) * t
                          + jnp.arange(t))[None, :]
             if attn_fn is None:
-                attn_fn = ring_attn_fn(self.seq_axis)
+                attn_fn = ring_attn_fn(self.seq_axis,
+                                       q_chunk=self.attn_q_chunk)
         else:
             t_global = t
             positions = jnp.arange(t)[None, :]

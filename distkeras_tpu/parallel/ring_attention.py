@@ -59,91 +59,163 @@ def _block_mask(src, t_local, q_pos):
     return (q_pos[:, None] >= k_pos[None, :])[None, None]
 
 
-def _forward_scan(q, k, v, axis_name, scale, causal):
+def _chunks(q_chunk, t_local):
+    """Validated (n_chunks, chunk_len) for within-device q blocking."""
+    if q_chunk is None or q_chunk >= t_local:
+        return 1, t_local
+    if q_chunk < 1 or t_local % q_chunk:
+        raise ValueError(
+            f"q_chunk={q_chunk} must be a positive divisor of the "
+            f"local sequence length {t_local}")
+    return t_local // q_chunk, q_chunk
+
+
+def _chunk_q_major(x, n_c, qc):
+    """[B, T, ...] -> chunk-major [n_c, B, qc, ...]."""
+    b = x.shape[0]
+    return jnp.moveaxis(x.reshape(b, n_c, qc, *x.shape[2:]), 1, 0)
+
+
+def _chunk_bh_major(x, n_c, qc):
+    """[B, H, T] -> chunk-major [n_c, B, H, qc]."""
+    b, h = x.shape[:2]
+    return jnp.moveaxis(x.reshape(b, h, n_c, qc), 2, 0)
+
+
+def _pos_chunks(me, t_local, n_c, qc):
+    """Global q positions of this device's block, chunked [n_c, qc]."""
+    return (me * t_local + jnp.arange(t_local)).reshape(n_c, qc)
+
+
+def _forward_scan(q, k, v, axis_name, scale, causal, q_chunk=None):
     """Online-softmax ring forward.  Returns ``(out32 [B,T,H,D],
     L [B,H,T])`` where ``L = m + log(l)`` is the per-row logsumexp the
-    backward pass needs to re-normalize recomputed probabilities."""
+    backward pass needs to re-normalize recomputed probabilities.
+
+    ``q_chunk`` blocks the within-device q dimension (flash-style):
+    each ring hop processes q in chunks of that length sequentially
+    (``lax.map``), bounding the transient logits block to
+    ``[B, H, q_chunk, T_local]`` instead of ``[B, H, T_local,
+    T_local]``.  All accumulators stay chunk-major for the whole ring
+    scan and are unblocked once at the end."""
     q32 = q.astype(jnp.float32)
     b, t_local, h, d = q32.shape
     n, me, ring = _ring(axis_name)
-    q_pos = me * t_local + jnp.arange(t_local)
+    n_c, qc = _chunks(q_chunk, t_local)
+    # chunk-major layouts: q [n_c, B, qc, H, D]; bookkeeping
+    # [n_c, B, H, qc(, D)]; positions [n_c, qc]
+    q_ch = _chunk_q_major(q32, n_c, qc)
+    pos_ch = _pos_chunks(me, t_local, n_c, qc)
 
     def body(carry, s):
         k_blk, v_blk, m, l, acc = carry
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
-                            k_blk.astype(jnp.float32)) * scale
-        if causal:
-            mask = _block_mask((me + s) % n, t_local, q_pos)
-            logits = jnp.where(mask, logits, _NEG)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        if causal:
-            p = p * mask  # exact zeros for masked entries
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        k32 = k_blk.astype(jnp.float32)
+        v32 = v_blk.astype(jnp.float32)
+        src = (me + s) % n
+
+        def chunk(args):
+            q_c, pos_c, m_c, l_c, acc_c = args
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q_c, k32) * scale
+            if causal:
+                mask = _block_mask(src, t_local, pos_c)
+                logits = jnp.where(mask, logits, _NEG)
+            m_new = jnp.maximum(m_c, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            if causal:
+                p = p * mask  # exact zeros for masked entries
+            corr = jnp.exp(m_c - m_new)
+            l_c = l_c * corr + p.sum(axis=-1)
+            acc_c = acc_c * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v32)
+            return m_new, l_c, acc_c
+
+        m, l, acc = lax.map(chunk, (q_ch, pos_ch, m, l, acc))
         # Rotate (the hop after the last step restores the original
         # placement, which keeps the scan carry shape uniform).
         k_blk = lax.ppermute(k_blk, axis_name, ring)
         v_blk = lax.ppermute(v_blk, axis_name, ring)
-        return (k_blk, v_blk, m_new, l, acc), None
+        return (k_blk, v_blk, m, l, acc), None
 
     init = (k, v, *_vary(axis_name, (
-        jnp.full((b, h, t_local), _NEG, jnp.float32),
-        jnp.zeros((b, h, t_local), jnp.float32),
-        jnp.zeros((b, h, t_local, d), jnp.float32))))
+        jnp.full((n_c, b, h, qc), _NEG, jnp.float32),
+        jnp.zeros((n_c, b, h, qc), jnp.float32),
+        jnp.zeros((n_c, b, h, qc, d), jnp.float32))))
     (_, _, m, l, acc), _ = lax.scan(body, init, jnp.arange(n))
+    # un-chunk: [n_c, B, H, qc(, D)] -> [B, H, T(, D)]
+    m = jnp.moveaxis(m, 0, 2).reshape(b, h, t_local)
+    l = jnp.moveaxis(l, 0, 2).reshape(b, h, t_local)
+    acc = jnp.moveaxis(acc, 0, 2).reshape(b, h, t_local, d)
     l = jnp.maximum(l, 1e-30)
     out = jnp.einsum("bhqd->bqhd", acc / l[..., None])
     return out, m + jnp.log(l)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_attention_f32(q, k, v, axis_name, scale, causal):
-    out, _ = _forward_scan(q, k, v, axis_name, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_f32(q, k, v, axis_name, scale, causal, q_chunk):
+    out, _ = _forward_scan(q, k, v, axis_name, scale, causal, q_chunk)
     return out
 
 
-def _fwd(q, k, v, axis_name, scale, causal):
-    out, lse = _forward_scan(q, k, v, axis_name, scale, causal)
+def _fwd(q, k, v, axis_name, scale, causal, q_chunk):
+    out, lse = _forward_scan(q, k, v, axis_name, scale, causal,
+                             q_chunk)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(axis_name, scale, causal, residuals, dout):
+def _bwd(axis_name, scale, causal, q_chunk, residuals, dout):
     """Reverse ring: the flash-attention backward, with dK/dV
     accumulators traveling *with* their K/V blocks around the ring so
     each returns home after N hops having collected every device's
     contribution.  Per-device memory is O(T_local) — no per-step
-    residual stacks (the motivation for the custom VJP)."""
+    residual stacks (the motivation for the custom VJP).  ``q_chunk``
+    blocks the q dimension within each hop exactly as the forward does
+    (an inner ``lax.scan`` carrying the dK/dV accumulation across
+    chunks)."""
     q, k, v, out, lse = residuals
     q32 = q.astype(jnp.float32)
     dout32 = dout.astype(jnp.float32)
     b, t_local, h, d = q32.shape
     n, me, ring = _ring(axis_name)
-    q_pos = me * t_local + jnp.arange(t_local)
+    n_c, qc = _chunks(q_chunk, t_local)
     # D_i = rowsum(dO_i * O_i), the softmax-jacobian diagonal term
     D = jnp.einsum("bqhd,bqhd->bhq", dout32, out.astype(jnp.float32))
+    # chunk-major per-q tensors
+    q_ch = _chunk_q_major(q32, n_c, qc)
+    dout_ch = _chunk_q_major(dout32, n_c, qc)
+    lse_ch = _chunk_bh_major(lse, n_c, qc)
+    d_ch = _chunk_bh_major(D, n_c, qc)
+    pos_ch = _pos_chunks(me, t_local, n_c, qc)
 
     def body(carry, s):
         k_blk, v_blk, dk, dv, dq = carry
         k32 = k_blk.astype(jnp.float32)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
-        if causal:
-            # mask BEFORE exp (as the forward does): a masked future-key
-            # logit can exceed lse by enough to overflow exp; relying on
-            # inf * False == 0 would pin correctness to a lowering detail
-            mask = _block_mask((me + s) % n, t_local, q_pos)
-            logits = jnp.where(mask, logits, _NEG)
-        p = jnp.exp(logits - lse[..., None])  # normalized probs
-        if causal:
-            p = p * mask  # exact zeros
-        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, dout32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dout32,
-                        v_blk.astype(jnp.float32))
-        ds = p * (dp - D[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
-        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+        v32 = v_blk.astype(jnp.float32)
+        src = (me + s) % n
+
+        def chunk(kv_carry, args):
+            dk_a, dv_a = kv_carry
+            q_c, pos_c, dout_c, lse_c, d_c, dq_c = args
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q_c, k32) * scale
+            if causal:
+                # mask BEFORE exp (as the forward does): a masked
+                # future-key logit can exceed lse by enough to overflow
+                # exp; relying on inf * False == 0 would pin
+                # correctness to a lowering detail
+                mask = _block_mask(src, t_local, pos_c)
+                logits = jnp.where(mask, logits, _NEG)
+            p = jnp.exp(logits - lse_c[..., None])  # normalized probs
+            if causal:
+                p = p * mask  # exact zeros
+            dv_a = dv_a + jnp.einsum("bhqk,bqhd->bkhd", p, dout_c)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", dout_c, v32)
+            ds = p * (dp - d_c[..., None]) * scale
+            dq_c = dq_c + jnp.einsum("bhqk,bkhd->bqhd", ds, k32)
+            dk_a = dk_a + jnp.einsum("bhqk,bqhd->bkhd", ds, q_c)
+            return (dk_a, dv_a), dq_c
+
+        (dk, dv), dq = lax.scan(
+            chunk, (dk, dv),
+            (q_ch, pos_ch, dout_ch, lse_ch, d_ch, dq))
         k_blk = lax.ppermute(k_blk, axis_name, ring)
         v_blk = lax.ppermute(v_blk, axis_name, ring)
         dk = lax.ppermute(dk, axis_name, ring)
@@ -151,8 +223,10 @@ def _bwd(axis_name, scale, causal, residuals, dout):
         return (k_blk, v_blk, dk, dv, dq), None
 
     zeros_kv = jnp.zeros((b, t_local, h, d), jnp.float32)
-    init = (k, v, *_vary(axis_name, (zeros_kv, zeros_kv, zeros_kv)))
+    dq0 = jnp.zeros((n_c, b, qc, h, d), jnp.float32)
+    init = (k, v, *_vary(axis_name, (zeros_kv, zeros_kv, dq0)))
     (_, _, dk, dv, dq), _ = lax.scan(body, init, jnp.arange(n))
+    dq = jnp.moveaxis(dq, 0, 1).reshape(b, t_local, h, d)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
@@ -161,7 +235,8 @@ _ring_attention_f32.defvjp(_fwd, _bwd)
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, scale: float | None = None,
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True,
+                   q_chunk: int | None = None) -> jax.Array:
     """Exact (flash-accumulated) attention over a ring of devices.
 
     Args:
@@ -171,6 +246,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
       axis_name: the mesh axis the sequence is sharded over.
       scale: logit scale; defaults to ``D ** -0.5``.
       causal: apply a causal mask in *global* positions.
+      q_chunk: optional within-device q block length (must divide
+        ``T_local``).  Default (None) computes each ring hop's full
+        ``[T_local, T_local]`` logits block at once; setting it
+        processes q in chunks of this length sequentially, bounding the
+        transient block to ``[q_chunk, T_local]`` — the flash-style
+        memory/throughput trade for long local sequences.  Numerics are
+        identical up to f32 reduction order.
 
     Returns:
       Attention output ``[B, T_local, H, D]`` in ``q.dtype`` (all
@@ -179,21 +261,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Differentiation uses a custom reverse-ring VJP (flash backward:
     probabilities recomputed from the saved logsumexp, dK/dV
     accumulators riding the ring) with O(T_local) residual memory per
-    device.  First-order only — higher-order autodiff through this op
-    is not defined.
+    device, honoring ``q_chunk``.  First-order only — higher-order
+    autodiff through this op is not defined.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    out = _ring_attention_f32(q, k, v, axis_name, float(scale),
-                              bool(causal))
+    out = _ring_attention_f32(
+        q, k, v, axis_name, float(scale), bool(causal),
+        None if q_chunk is None else int(q_chunk))
     return out.astype(q.dtype)
 
 
-def ring_attn_fn(axis_name: str, causal: bool = True):
+def ring_attn_fn(axis_name: str, causal: bool = True,
+                 q_chunk: int | None = None):
     """An ``AttnFn`` (``TransformerLM.attn_fn`` signature) bound to a
     mesh axis: ``fn(q, k, v, *, scale)``."""
     return functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal)
+                             causal=causal, q_chunk=q_chunk)
 
 
 def sequence_sharded_apply(fn, mesh, seq_axis: str, *,

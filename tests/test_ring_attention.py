@@ -41,12 +41,14 @@ def _dense_full_attention(q, k, v, *, scale):
 
 
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_matches_dense(causal):
+@pytest.mark.parametrize("q_chunk", [None, 4])
+def test_ring_matches_dense(causal, q_chunk):
     mesh = _mesh()
     q, k, v = _qkv()
     scale = q.shape[-1] ** -0.5
     ring = jax.shard_map(
-        functools.partial(ring_attention, axis_name=SEQ, causal=causal),
+        functools.partial(ring_attention, axis_name=SEQ, causal=causal,
+                          q_chunk=q_chunk),
         mesh=mesh, in_specs=(P(None, SEQ), P(None, SEQ), P(None, SEQ)),
         out_specs=P(None, SEQ))
     got = np.asarray(jax.jit(ring)(q, k, v))
@@ -56,14 +58,27 @@ def test_ring_matches_dense(causal):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
-def test_ring_gradients_match_dense():
+def test_indivisible_q_chunk_raises():
+    mesh = _mesh()
+    q, k, v = _qkv()  # t_local = 8 per device
+    ring = jax.shard_map(
+        functools.partial(ring_attention, axis_name=SEQ, q_chunk=3),
+        mesh=mesh, in_specs=(P(None, SEQ), P(None, SEQ), P(None, SEQ)),
+        out_specs=P(None, SEQ))
+    with pytest.raises(ValueError, match="q_chunk"):
+        jax.jit(ring)(q, k, v)
+
+
+@pytest.mark.parametrize("q_chunk", [None, 2])
+def test_ring_gradients_match_dense(q_chunk):
     mesh = _mesh()
     q, k, v = _qkv(seed=1)
     probe = jax.random.normal(jax.random.key(9), q.shape)
 
     def ring_loss(q, k, v):
         out = jax.shard_map(
-            functools.partial(ring_attention, axis_name=SEQ),
+            functools.partial(ring_attention, axis_name=SEQ,
+                              q_chunk=q_chunk),
             mesh=mesh,
             in_specs=(P(None, SEQ), P(None, SEQ), P(None, SEQ)),
             out_specs=P(None, SEQ))(q, k, v)
@@ -142,3 +157,19 @@ def test_sequence_parallel_training_grads_match_dense():
     for g, w in zip(flat_g, flat_w):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_transformer_attn_q_chunk_matches_dense():
+    """TransformerLM(seq_axis=..., attn_q_chunk=...) — chunked ring
+    attention through the full model equals the dense twin."""
+    mesh = _mesh()
+    dense_model = _lm_spec().build()
+    seq_model = _lm_spec(seq_axis=SEQ, attn_q_chunk=4).build()
+
+    tokens = jax.random.randint(jax.random.key(8), (2, 32), 0, 64)
+    variables = dense_model.init(jax.random.key(9), tokens)
+    want = np.asarray(dense_model.apply(variables, tokens))
+    sp_apply = sequence_sharded_apply(
+        lambda vs, toks: seq_model.apply(vs, toks), mesh, SEQ)
+    got = np.asarray(jax.jit(sp_apply)(variables, tokens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
