@@ -76,6 +76,99 @@ def save_checkpoint(path: str | os.PathLike, state: Pytree,
     return str(path)
 
 
+SHARDED = "ckpt_sharded"
+_POINTER = "LATEST"
+
+
+def _sharded_latest(root: pathlib.Path) -> str | None:
+    pointer = root / _POINTER
+    if not pointer.exists():
+        return None
+    tag = pointer.read_text().strip()
+    return tag if (root / tag).exists() else None
+
+
+def has_sharded(path: str | os.PathLike) -> bool:
+    """True if ``path`` holds a complete sharded (orbax) checkpoint."""
+    return _sharded_latest(pathlib.Path(path) / SHARDED) is not None
+
+
+def save_sharded(path: str | os.PathLike, state: Pytree,
+                 cursor: Mapping[str, Any]) -> str:
+    """Sharded / multi-host checkpoint via orbax.
+
+    Unlike ``save_checkpoint`` (which fetches the whole state to one
+    host), every process writes only its own array shards, so this
+    works for tensor-parallel or otherwise non-fully-addressable
+    state spanning hosts.  All processes must call it (orbax
+    coordinates via the jax.distributed client).  Restore with
+    ``load_sharded`` against an identically-sharded template.
+
+    Crash-safe like the msgpack path: each save point writes to its
+    own cursor-derived directory and only then atomically updates a
+    ``LATEST`` pointer, so a kill mid-save always leaves the previous
+    checkpoint loadable and never a state/cursor mismatch.  Older save
+    points are pruned after the pointer moves.
+    """
+    import orbax.checkpoint as ocp
+
+    root = pathlib.Path(path).resolve() / SHARDED
+    parts = ["".join(c for c in f"{k}{cursor[k]}"
+                     if c.isalnum() or c in "-.")
+             for k in sorted(cursor)
+             if isinstance(cursor[k], (int, float, str))]
+    tag = "state_" + ("_".join(parts) if parts else "0")
+    ckptr = ocp.StandardCheckpointer()
+    # force only clears a half-written attempt at THIS tag (a prior
+    # crash); completed older tags stay untouched until the pointer
+    # moves past them.
+    ckptr.save(root / tag, pack_prng_keys(state), force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        tmp = root / (tag + ".cursor.tmp")
+        tmp.write_text(json.dumps(dict(cursor)))
+        os.replace(tmp, root / (tag + ".cursor.json"))
+        tmp = root / (_POINTER + ".tmp")
+        tmp.write_text(tag)
+        os.replace(tmp, root / _POINTER)
+        for old in root.iterdir():  # prune superseded save points
+            if (old.name.startswith("state_") and old.is_dir()
+                    and old.name != tag):
+                import shutil
+
+                shutil.rmtree(old, ignore_errors=True)
+                (root / (old.name + ".cursor.json")).unlink(
+                    missing_ok=True)
+    return str(root)
+
+
+def load_sharded(path: str | os.PathLike, state_template: Pytree
+                 ) -> tuple[Pytree, dict]:
+    """Restore a ``save_sharded`` checkpoint INTO the template's
+    shardings: ``state_template`` is a pytree of (sharded) arrays — or
+    ``jax.ShapeDtypeStruct``s with ``.sharding`` — matching the saved
+    structure; each process reads only the shards it owns."""
+    import orbax.checkpoint as ocp
+
+    root = pathlib.Path(path).resolve() / SHARDED
+    tag = _sharded_latest(root)
+    if tag is None:
+        raise FileNotFoundError(
+            f"no complete sharded checkpoint under {root}")
+    packed = pack_prng_keys(state_template)
+    abstract = jax.tree_util.tree_map(
+        lambda v: v if isinstance(v, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                  sharding=getattr(v, "sharding",
+                                                   None)),
+        packed)
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(root / tag, abstract)
+    state = unpack_prng_keys(state_template, restored)
+    cursor = json.loads((root / (tag + ".cursor.json")).read_text())
+    return state, cursor
+
+
 def load_checkpoint(path: str | os.PathLike, state_template: Pytree
                     ) -> tuple[Pytree, dict]:
     """Read a checkpoint written by ``save_checkpoint``.

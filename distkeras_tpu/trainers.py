@@ -25,6 +25,7 @@ SURVEY.md §5 "honest observability").
 
 from __future__ import annotations
 
+import pathlib
 import time
 from typing import Any, ClassVar, Mapping, Sequence
 
@@ -175,8 +176,35 @@ class Trainer:
         if self.checkpoint_dir is not None:
             from distkeras_tpu import checkpoint as ckpt
 
-            ckpt.save_checkpoint(self.checkpoint_dir, state,
-                                 {**cursor, "history": self.history})
+            cursor = {**cursor, "history": self.history}
+            if getattr(self, "_sharded_ckpt", False):
+                # multi-host sharded state: every process writes only
+                # its own shards (orbax)
+                ckpt.save_sharded(self.checkpoint_dir, state, cursor)
+                # one layout per dir (see the mirror-image cleanup in
+                # the msgpack branch)
+                if jax.process_index() == 0:
+                    (pathlib.Path(self.checkpoint_dir) /
+                     ckpt.LATEST).unlink(missing_ok=True)
+            else:
+                ckpt.save_checkpoint(self.checkpoint_dir, state,
+                                     cursor)
+                # one layout per dir: a stale sharded checkpoint left
+                # from an earlier multi-host run would otherwise shadow
+                # this (newer) msgpack save at the next resume
+                if ckpt.has_sharded(self.checkpoint_dir) and \
+                        jax.process_index() == 0:
+                    import shutil
+
+                    shutil.rmtree(
+                        pathlib.Path(self.checkpoint_dir) /
+                        ckpt.SHARDED, ignore_errors=True)
+
+    def _restore_history(self, cursor: dict) -> dict:
+        """Pop the checkpointed history into ``self.history``."""
+        self.history = {k: list(v)
+                        for k, v in cursor.pop("history", {}).items()}
+        return cursor
 
     def _maybe_resume(self, resume_from, state_template):
         """Returns (state, cursor) — (template, {}) when not resuming."""
@@ -185,9 +213,7 @@ class Trainer:
         from distkeras_tpu import checkpoint as ckpt
 
         state, cursor = ckpt.load_checkpoint(resume_from, state_template)
-        self.history = {k: list(v)
-                        for k, v in cursor.pop("history", {}).items()}
-        return state, cursor
+        return state, self._restore_history(cursor)
 
 
 class SingleTrainer(Trainer):
@@ -263,13 +289,10 @@ class SyncTrainer(Trainer):
             raise ValueError(
                 f"model_parallel={mp} with {num_workers} workers needs "
                 f"{num_workers * mp} devices, have {len(devices)}")
-        if mp > 1 and self.checkpoint_dir and jax.process_count() > 1:
-            # Multi-host TP state is not fully addressable; save_checkpoint
-            # would need a per-shard (orbax-style distributed) layout.
-            raise NotImplementedError(
-                "checkpointing tensor-parallel state on multi-host runs "
-                "is not supported yet; checkpoint single-host or with "
-                "model_parallel=1")
+        # Multi-host TP state is not fully addressable: switch
+        # _maybe_save to the per-shard orbax layout (checkpoint.py
+        # save_sharded) instead of the single-file msgpack fetch.
+        self._sharded_ckpt = mp > 1 and jax.process_count() > 1
         global_batch = self.batch_size * num_workers
         # Multi-host: every process runs this same program; each holds
         # only its rows of the (identically generated) global dataset and
@@ -286,8 +309,13 @@ class SyncTrainer(Trainer):
         variables = self._init_variables(initial_variables)
         state = TrainState.create(variables, tx,
                                   jax.random.key(self.seed + 1))
-        state, cursor = self._maybe_resume(resume_from, state)
-        start_epoch = int(cursor.get("epoch", 0))
+        from distkeras_tpu import checkpoint as ckpt_mod
+
+        resume_sharded = (resume_from is not None
+                          and ckpt_mod.has_sharded(resume_from))
+        cursor: dict = {}
+        if not resume_sharded:
+            state, cursor = self._maybe_resume(resume_from, state)
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
         run_chunk = make_window_runner(step)
@@ -310,13 +338,24 @@ class SyncTrainer(Trainer):
                 state_sharding = rep
             state = mesh_lib.global_batch_from_local(state_sharding,
                                                      state)
+            if resume_sharded:
+                # sharded (orbax) checkpoints restore INTO the mesh
+                # shardings — each process reads only its own shards
+                state, cursor = ckpt_mod.load_sharded(resume_from,
+                                                      state)
+                cursor = self._restore_history(cursor)
             run_chunk = jax.jit(
                 run_chunk,
                 in_shardings=(state_sharding, batch_sharded),
                 out_shardings=(state_sharding, rep))
+        elif resume_sharded:
+            raise ValueError(
+                f"{resume_from!r} holds a sharded checkpoint but this "
+                f"run has no mesh to restore it onto")
         else:
             run_chunk = jax.jit(run_chunk)
 
+        start_epoch = int(cursor.get("epoch", 0))
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
             shard = mesh_lib.process_shard(

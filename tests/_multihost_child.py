@@ -51,6 +51,24 @@ def main():
                      num_epoch=1, learning_rate=0.05)
     tp.train(data)
 
+    # Multi-host sharded checkpointing: a TP run killed at 1/2 epochs
+    # writes the orbax per-shard layout; resuming reproduces the
+    # uninterrupted 2-epoch run's history.
+    tp_resume_match = None
+    ckpt_dir = os.environ.get("DKT_CKPT_DIR")
+    if ckpt_dir:
+        tp_kwargs = dict(num_workers=4, model_parallel=2, batch_size=8,
+                         learning_rate=0.05)
+        full = SyncTrainer(cfg, num_epoch=2, **tp_kwargs)
+        full.train(data)
+        part = SyncTrainer(cfg, num_epoch=1, checkpoint_dir=ckpt_dir,
+                           **tp_kwargs)
+        part.train(data)
+        resumed = SyncTrainer(cfg, num_epoch=2, **tp_kwargs)
+        resumed.train(data, resume_from=ckpt_dir)
+        tp_resume_match = (resumed.history["epoch_loss"]
+                           == full.history["epoch_loss"])
+
     print(json.dumps({
         "process": jax.process_index(),
         "sync_epoch_loss": [round(x, 6)
@@ -62,6 +80,7 @@ def main():
                             for x in small.history["epoch_loss"]],
         "tp_sync_loss": [round(x, 6)
                          for x in tp.history["epoch_loss"]],
+        "tp_resume_match": tp_resume_match,
     }))
 
 

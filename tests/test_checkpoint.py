@@ -164,3 +164,122 @@ def test_sync_tp_kill_and_resume(tmp_path):
                     _leaves(resumed.trained_variables)):
         np.testing.assert_array_equal(a, b)
     assert resumed.history["epoch_loss"] == ref.history["epoch_loss"]
+
+
+def test_sharded_roundtrip_bitwise(tmp_path, devices):
+    """orbax-backed sharded checkpoint: TP-sharded TrainState saves
+    shard-wise and restores INTO the mesh shardings, bitwise."""
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.checkpoint import (has_sharded, load_sharded,
+                                          save_sharded)
+    from distkeras_tpu.models import ModelSpec
+    from distkeras_tpu.parallel import tensor_parallel as tp
+    from distkeras_tpu.workers import TrainState, resolve_optimizer
+
+    spec = ModelSpec.from_config(MLP)
+    variables = spec.build().init(jax.random.key(0),
+                                  np.zeros((2, 8), np.float32))
+    state = TrainState.create(variables, resolve_optimizer("adam", 1e-3),
+                              jax.random.key(1))
+    mesh = mesh_lib.create_mesh(4, model_parallel=2)
+    shardings = tp.tree_shardings(mesh, state, tp.rules_for("mlp"))
+    state = jax.device_put(state, shardings)
+
+    assert not has_sharded(tmp_path)
+    save_sharded(tmp_path, state, {"epoch": 2})
+    assert has_sharded(tmp_path)
+    loaded, cursor = load_sharded(tmp_path, state)
+    assert cursor == {"epoch": 2}
+    for a, b in zip(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, state.params)),
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(np.asarray, loaded.params))):
+        np.testing.assert_array_equal(a, b)
+    # shardings restored, not just values
+    flat_s = jax.tree_util.tree_leaves(
+        state, is_leaf=lambda x: hasattr(x, "sharding"))
+    flat_l = jax.tree_util.tree_leaves(
+        loaded, is_leaf=lambda x: hasattr(x, "sharding"))
+    for a, b in zip(flat_s, flat_l):
+        assert a.sharding == b.sharding
+
+
+def test_sync_tp_resume_from_sharded_checkpoint(tmp_path, devices):
+    """SyncTrainer resumes from a sharded (orbax) checkpoint dir: the
+    continuation matches the uninterrupted run."""
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.checkpoint import (load_checkpoint, save_sharded)
+    from distkeras_tpu.models import ModelSpec
+    from distkeras_tpu.parallel import tensor_parallel as tp
+    from distkeras_tpu.trainers import SyncTrainer
+    from distkeras_tpu.workers import TrainState, resolve_optimizer
+
+    kwargs = dict(worker_optimizer="adam", learning_rate=3e-3,
+                  batch_size=16, num_epoch=3, seed=2, num_workers=2,
+                  model_parallel=2)
+    ref = SyncTrainer(MLP, **kwargs)
+    ref.train(DATA)
+
+    msgpack_dir = tmp_path / "msgpack"
+    part = SyncTrainer(MLP, checkpoint_dir=str(msgpack_dir),
+                       **{**kwargs, "num_epoch": 2})
+    part.train(DATA)
+
+    # convert the killed-at-2/3 checkpoint to the sharded layout (what
+    # a multi-host TP run writes) and resume from it
+    spec = ModelSpec.from_config(MLP)
+    variables = spec.build().init(jax.random.key(0),
+                                  np.zeros((2, 8), np.float32))
+    template = TrainState.create(
+        variables, resolve_optimizer("adam", 3e-3), jax.random.key(0))
+    host_state, cursor = load_checkpoint(msgpack_dir, template)
+    mesh = mesh_lib.create_mesh(2, model_parallel=2)
+    sharded_state = jax.device_put(
+        host_state, tp.tree_shardings(mesh, host_state,
+                                      tp.rules_for("mlp")))
+    sharded_dir = tmp_path / "sharded"
+    save_sharded(sharded_dir, sharded_state, cursor)
+
+    resumed = SyncTrainer(MLP, **kwargs)
+    resumed.train(DATA, resume_from=str(sharded_dir))
+    for a, b in zip(_leaves(ref.trained_variables),
+                    _leaves(resumed.trained_variables)):
+        np.testing.assert_array_equal(a, b)
+    assert resumed.history["epoch_loss"] == ref.history["epoch_loss"]
+
+
+def test_msgpack_save_clears_stale_sharded_layout(tmp_path, devices):
+    """One layout per dir: a later msgpack save (single-host run)
+    removes a stale sharded checkpoint so resume can't silently restore
+    old state."""
+    from distkeras_tpu.checkpoint import has_sharded, save_sharded
+    from distkeras_tpu.models import ModelSpec
+    from distkeras_tpu.trainers import SingleTrainer
+    from distkeras_tpu.workers import TrainState, resolve_optimizer
+
+    spec = ModelSpec.from_config(MLP)
+    variables = spec.build().init(jax.random.key(0),
+                                  np.zeros((2, 8), np.float32))
+    state = TrainState.create(variables,
+                              resolve_optimizer("adam", 1e-3),
+                              jax.random.key(1))
+    save_sharded(tmp_path, state, {"epoch": 9})
+    assert has_sharded(tmp_path)
+
+    t = SingleTrainer(MLP, checkpoint_dir=str(tmp_path),
+                      worker_optimizer="adam", learning_rate=3e-3,
+                      batch_size=64, num_epoch=1)
+    t.train(DATA)
+    assert not has_sharded(tmp_path)  # stale layout gone
+
+
+def test_incomplete_sharded_save_is_invisible(tmp_path):
+    """has_sharded requires a complete save: a pointer to a missing
+    save point (crash mid-write) reads as no checkpoint."""
+    from distkeras_tpu.checkpoint import SHARDED, has_sharded
+
+    root = tmp_path / SHARDED
+    root.mkdir(parents=True)
+    assert not has_sharded(tmp_path)  # no pointer
+    (root / "LATEST").write_text("state_epoch3")
+    assert not has_sharded(tmp_path)  # pointer to nothing

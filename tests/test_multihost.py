@@ -41,11 +41,14 @@ def test_tpu_pod_job_builds_gcloud_command():
 
 
 @pytest.mark.parametrize("num_processes", [2])
-def test_two_process_cluster_trains_and_agrees(num_processes):
+def test_two_process_cluster_trains_and_agrees(num_processes,
+                                               tmp_path):
     """Sync + async-PS training over a mesh spanning 2 real processes:
     both processes must converge and report identical global losses."""
     results = deploy.run_multiprocess(
-        CHILD, num_processes, env={"PYTHONPATH": REPO},
+        CHILD, num_processes,
+        env={"PYTHONPATH": REPO,
+             "DKT_CKPT_DIR": str(tmp_path / "tp_ckpt")},
         timeout_s=600.0)
     assert len(results) == num_processes
     payloads = []
@@ -64,6 +67,10 @@ def test_two_process_cluster_trains_and_agrees(num_processes):
     # the dp-only run of the same configuration
     np.testing.assert_allclose(a["tp_sync_loss"], a["small_sync_loss"],
                                rtol=2e-4, atol=2e-5)
+    # multi-host sharded (orbax) checkpoint: kill-at-1/2 + resume
+    # reproduced the uninterrupted run on both processes
+    assert a["tp_resume_match"] is True
+    assert b["tp_resume_match"] is True
     # and real training signal
     sync = a["sync_epoch_loss"]
     assert sync[-1] < sync[0], sync
