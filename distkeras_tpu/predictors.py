@@ -35,7 +35,8 @@ class ModelPredictor:
                  output_col: str = "prediction",
                  output: str = "logits",
                  batch_size: int = 512,
-                 num_shards: int | None = None):
+                 num_shards: int | None = None,
+                 model_parallel: int = 1, tp_rules=None):
         if isinstance(model, ModelSpec):
             self.spec = model
         elif isinstance(model, Mapping):
@@ -54,12 +55,29 @@ class ModelPredictor:
             raise ValueError(f"unknown output {output!r}")
         self.output = output
         self.batch_size = int(batch_size)
+        self.model_parallel = int(model_parallel)
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"model_parallel must be >= 1, got {model_parallel}")
+        if tp_rules is not None and self.model_parallel == 1:
+            raise ValueError(
+                "tp_rules given but model_parallel=1 — pass "
+                "model_parallel>1 to shard parameters")
 
         devices = jax.devices()
-        self.num_shards = num_shards or len(devices)
-        self._mesh = (mesh_lib.create_mesh(self.num_shards)
-                      if self.num_shards > 1
-                      and len(devices) >= self.num_shards else None)
+        mp = self.model_parallel
+        self.num_shards = (num_shards
+                           or max(1, len(devices) // mp))
+        if mp > 1:
+            # create_mesh validates the device budget and raises its
+            # own (identical) error when devices are short
+            self._mesh = mesh_lib.create_mesh(self.num_shards,
+                                              model_parallel=mp)
+        else:
+            self._mesh = (mesh_lib.create_mesh(self.num_shards)
+                          if self.num_shards > 1
+                          and len(devices) >= self.num_shards
+                          else None)
 
         def forward(variables, x):
             logits = self.model.apply(variables, x, train=False)
@@ -70,9 +88,29 @@ class ModelPredictor:
             return logits
 
         if self._mesh is not None:
-            rep = NamedSharding(self._mesh, P())
             row = NamedSharding(self._mesh, P(mesh_lib.WORKER_AXIS))
-            self._forward = jax.jit(forward, in_shardings=(rep, row),
+            if mp > 1:
+                # Megatron-sharded params over the model axis; GSPMD
+                # derives the TP collectives (same rules the trainers
+                # use — see parallel.tensor_parallel)
+                from distkeras_tpu.parallel import tensor_parallel as tp
+
+                if tp_rules is None:
+                    if self.spec is None:
+                        raise ValueError(
+                            "model_parallel>1 with a bare flax module "
+                            "needs explicit tp_rules (a ModelSpec "
+                            "carries the family to look them up)")
+                    tp_rules = tp.rules_for(self.spec.family)
+                var_sharding = tp.tree_shardings(self._mesh,
+                                                 self.variables,
+                                                 tp_rules)
+                self.variables = jax.device_put(self.variables,
+                                                var_sharding)
+            else:
+                var_sharding = NamedSharding(self._mesh, P())
+            self._forward = jax.jit(forward,
+                                    in_shardings=(var_sharding, row),
                                     out_shardings=row)
         else:
             self._forward = jax.jit(forward)
