@@ -1,12 +1,13 @@
-"""Expert parallelism: a Switch-style top-1 MoE layer over a mesh axis.
+"""Expert parallelism: a top-k gated MoE layer over a mesh axis.
 
 Beyond the reference (SURVEY.md §2.3: "Expert parallelism: NO") —
 the last of the five parallelism forms (dp/tp/sp/pp/ep).  Experts'
 FFN parameters are sharded over the ``expert`` mesh axis; tokens are
 routed with the einsum dispatch/combine formulation (Shazeer et al.'s
-Mesh-TF Switch layout) and exchanged with ``lax.all_to_all`` over ICI:
+Mesh-TF layout — ``top_k=1`` is the Switch layer, ``top_k=2`` the
+GShard-style router) and exchanged with ``lax.all_to_all`` over ICI:
 
-1. router: per-token logits over all E experts, top-1 gate;
+1. router: per-token logits over all E experts, top-k gates;
 2. dispatch einsum builds ``[E, C, d]`` capacity-bucketed inputs;
 3. ``all_to_all`` turns token-sharding into expert-sharding — each
    device receives ITS experts' buckets from every device;
@@ -78,8 +79,13 @@ class MoEAux(NamedTuple):
     dropped_fraction: jax.Array   # scalar in [0, 1]
 
 
-def _routing(x, router, num_experts, capacity):
-    """Top-1 dispatch/combine tensors ([T, E, C]) + aux telemetry.
+def _routing(x, router, num_experts, capacity, top_k=1):
+    """Top-k dispatch/combine tensors ([T, E, C]) + aux telemetry.
+
+    ``top_k=1`` is the Switch layer; ``top_k=2`` the GShard-style
+    routing (gates renormalized over the chosen experts; later choices
+    fill capacity after earlier ones, so a token's second expert is
+    dropped before its first).
 
     All bookkeeping runs in f32 regardless of ``x.dtype``: bf16 cumsum
     loses integer exactness past 256, which would assign two tokens the
@@ -89,43 +95,64 @@ def _routing(x, router, num_experts, capacity):
     logits = (x.astype(jnp.float32)
               @ router.astype(jnp.float32))      # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = probs.max(axis=-1)                    # [T]
-    idx = probs.argmax(axis=-1)                  # [T]
-    mask = jax.nn.one_hot(idx, num_experts,
-                          dtype=jnp.float32)     # [T, E]
-    # position of each token within its expert's bucket
-    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask
-    keep = (pos < capacity).astype(jnp.float32) * mask
-    dispatch = keep[..., None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity,
-        dtype=jnp.float32)                       # [T, E, C]
-    combine = dispatch * gate[:, None, None]
-    # Switch aux loss: E * sum_e( frac_tokens_e * mean_prob_e )
-    frac = mask.mean(axis=0)
-    lb = num_experts * jnp.sum(frac * probs.mean(axis=0))
-    dropped = jnp.clip(1.0 - keep.sum() / t, 0.0, 1.0)  # f32 rounding
+    top_p, top_i = lax.top_k(probs, top_k)       # [T, k]
+    # Switch (k=1) gates with the raw probability; GShard (k>1)
+    # renormalizes over the chosen experts.
+    gates = (top_p if top_k == 1
+             else top_p / top_p.sum(axis=-1, keepdims=True))
+    dispatch = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    counts = jnp.zeros((num_experts,), jnp.float32)  # filled slots
+    kept = jnp.float32(0.0)
+    mask1 = None  # the j=0 mask, reused for the aux loss
+    for j in range(top_k):  # static, tiny k
+        mask = jax.nn.one_hot(top_i[:, j], num_experts,
+                              dtype=jnp.float32)  # [T, E]
+        if j == 0:
+            mask1 = mask
+        # position within the expert's bucket, offset by the slots
+        # already filled by earlier choices
+        pos = ((jnp.cumsum(mask, axis=0) - 1.0)
+               + counts[None, :]) * mask
+        keep = (pos < capacity).astype(jnp.float32) * mask
+        d_j = keep[..., None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity,
+            dtype=jnp.float32)                   # [T, E, C]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gates[:, j][:, None, None]
+        counts = counts + keep.sum(axis=0)  # kept only: slots stay dense
+        kept = kept + keep.sum()
+    # Switch aux loss on the primary choice:
+    # E * sum_e( frac_tokens_e * mean_prob_e )
+    lb = num_experts * jnp.sum(mask1.mean(axis=0) * probs.mean(axis=0))
+    dropped = jnp.clip(1.0 - kept / (t * top_k), 0.0, 1.0)
     return (dispatch.astype(x.dtype), combine.astype(x.dtype),
             MoEAux(lb, dropped))
 
 
 def moe_apply(params: MoEParams, x: jax.Array, *, axis_name: str,
-              capacity_factor: float = 1.25
+              capacity_factor: float = 1.25, top_k: int = 1
               ) -> tuple[jax.Array, MoEAux]:
     """Apply the expert-parallel MoE FFN to ``x`` ``[T_local, d]``.
 
     ``params`` leaves other than ``router`` carry this device's
-    ``E_local = E / n_devices`` experts.  Returns ``([T_local, d],
-    MoEAux)``; aux values are means over the mesh axis.
+    ``E_local = E / n_devices`` experts.  ``top_k=1`` is Switch
+    routing; ``top_k=2`` GShard-style (renormalized gates over the
+    chosen experts).  Returns ``([T_local, d], MoEAux)``; aux values
+    are means over the mesh axis.
     """
     n_dev = lax.axis_size(axis_name)
     e_local = params.w_in.shape[0]
     num_experts = e_local * n_dev
+    if not 1 <= top_k <= num_experts:
+        raise ValueError(
+            f"top_k={top_k} out of range [1, {num_experts}]")
     t_local, d = x.shape
     capacity = max(1, math.ceil(
-        t_local * capacity_factor / num_experts))
+        t_local * top_k * capacity_factor / num_experts))
 
     dispatch, combine, aux = _routing(x, params.router, num_experts,
-                                      capacity)
+                                      capacity, top_k)
 
     # [T, E, C] -> expert-major input buckets [E, C, d]
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)

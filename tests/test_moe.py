@@ -137,3 +137,90 @@ def test_bf16_tokens_route_exactly(devices):
     np.testing.assert_allclose(
         np.asarray(out16, dtype=np.float32), np.asarray(want),
         rtol=0.1, atol=0.1)  # bf16 compute tolerance; routing exact
+
+
+def _dense_topk_reference(params: MoEParams, x, k):
+    """Per-token top-k MoE, no capacity limit, renormalized gates."""
+    from jax import lax
+
+    probs = jax.nn.softmax(x @ params.router, axis=-1)
+    top_p, top_i = lax.top_k(probs, k)
+    gates = top_p / top_p.sum(axis=-1, keepdims=True)
+
+    def ffn(e, tok):
+        h = jax.nn.relu(tok @ params.w_in[e] + params.b_in[e])
+        return h @ params.w_out[e] + params.b_out[e]
+
+    def one_token(tok, idxs, gs):
+        return sum(gs[j] * ffn(idxs[j], tok) for j in range(k))
+
+    return jax.vmap(one_token)(x, top_i, gates)
+
+
+def test_top2_matches_dense_reference(devices):
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("expert",))
+    params = _params(seed=11)
+    x = jnp.asarray(np.random.default_rng(12).normal(size=(32, D)),
+                    jnp.float32)
+
+    def fn(p, x):
+        return moe_apply(p, x, axis_name="expert",
+                         capacity_factor=float(E), top_k=2)
+
+    out, aux = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(moe_pspecs("expert"), P("expert")),
+        out_specs=(P("expert"), MoEAux(P(), P()))))(params, x)
+    assert float(aux.dropped_fraction) == 0.0
+    want = _dense_topk_reference(params, x, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_top2_second_choice_drops_first(devices):
+    """Capacity pressure drops later choices before earlier ones: the
+    kept fraction under top_k=2 is at least the top-1 kept fraction."""
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("expert",))
+    params = _params(seed=13)
+    params = params._replace(
+        router=jnp.zeros((D, E)).at[:, 0].set(1.0).at[:, 1].set(0.5))
+    x = jnp.asarray(
+        np.abs(np.random.default_rng(14).normal(size=(32, D))),
+        jnp.float32)
+
+    def run(k):
+        def fn(p, x):
+            return moe_apply(p, x, axis_name="expert",
+                             capacity_factor=1.0, top_k=k)
+
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(moe_pspecs("expert"), P("expert")),
+            out_specs=(P("expert"), MoEAux(P(), P()))))(params, x)
+
+    _, aux1 = run(1)
+    _, aux2 = run(2)
+    assert float(aux2.dropped_fraction) > 0.0
+    assert np.isfinite(float(aux2.load_balance_loss))
+    # later choices fill capacity after earlier ones: the k=2 run keeps
+    # at least as many assignments as the whole k=1 run (its first
+    # choices alone fill at least that much)
+    t = 32
+    kept1 = (1.0 - float(aux1.dropped_fraction)) * t
+    kept2 = (1.0 - float(aux2.dropped_fraction)) * 2 * t
+    assert kept2 >= kept1 - 1e-3, (kept1, kept2)
+
+
+def test_bad_top_k_raises(devices):
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("expert",))
+    params = _params(seed=15)
+    x = jnp.zeros((8, D), jnp.float32)
+
+    def fn(p, x):
+        return moe_apply(p, x, axis_name="expert", top_k=0)
+
+    with np.testing.assert_raises(Exception):
+        jax.jit(jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(moe_pspecs("expert"), P("expert")),
+            out_specs=(P("expert"), MoEAux(P(), P()))))(params, x)
