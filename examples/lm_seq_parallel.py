@@ -24,6 +24,10 @@ def main():
     parser.add_argument("--vocab-size", type=int, default=64)
     parser.add_argument("--d-model", type=int, default=64)
     parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--q-chunk", type=int, default=None,
+                        help="within-device q block length for ring "
+                             "attention (bounds transient memory to "
+                             "[q_chunk, T_local] per hop)")
     args = parse_args_and_setup(parser)
 
     import time
@@ -41,6 +45,12 @@ def main():
     if args.seq_len % n_dev:
         raise SystemExit(f"--seq-len {args.seq_len} must divide by the "
                          f"{n_dev} devices")
+    t_local = args.seq_len // n_dev
+    if args.q_chunk and args.q_chunk < t_local \
+            and t_local % args.q_chunk:
+        raise SystemExit(
+            f"--q-chunk {args.q_chunk} must divide the per-device "
+            f"sequence length {t_local}")
     mesh = Mesh(np.asarray(jax.devices()), ("seq",))
 
     data = datasets.lm_synth(args.rows, seq_len=args.seq_len,
@@ -51,7 +61,7 @@ def main():
                   max_len=args.seq_len, dtype="float32")
     seq_model = ModelSpec.from_config(model_config(
         "transformer_lm", (args.seq_len,), input_dtype="int32",
-        seq_axis="seq", **lm_cfg)).build()
+        seq_axis="seq", attn_q_chunk=args.q_chunk, **lm_cfg)).build()
     dense_spec = ModelSpec.from_config(model_config(
         "transformer_lm", (args.seq_len,), input_dtype="int32",
         **lm_cfg))
