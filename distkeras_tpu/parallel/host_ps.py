@@ -122,6 +122,13 @@ class HostParameterServer:
         with self._lock:
             return self._center
 
+    def register(self, worker_id: int) -> None:
+        """Start liveness monitoring before first contact, so a worker
+        that hangs before ever reaching the server is still flagged by
+        ``idle_workers`` instead of being invisible."""
+        with self._lock:
+            self._last_seen.setdefault(worker_id, time.monotonic())
+
     def retire(self, worker_id: int) -> None:
         """A worker finished cleanly: stop monitoring it (so
         ``idle_workers`` never flags it) and drop its dedupe reply."""

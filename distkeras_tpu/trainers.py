@@ -400,6 +400,7 @@ class DistributedTrainer(Trainer):
                  checkpoint_every_rounds: int | None = None,
                  max_worker_failures: int = 0,
                  worker_retries: int = 0,
+                 worker_timeout: float | None = None,
                  fault_injector=None, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
@@ -415,7 +416,11 @@ class DistributedTrainer(Trainer):
         have died (default 0: fail fast, the round-1 behavior).
         ``fault_injector(worker, epoch, round)`` is the chaos hook —
         called before every round; raise from it to inject a failure
-        (SURVEY.md §5 "fault injection")."""
+        (SURVEY.md §5 "fault injection").  ``worker_timeout`` (seconds)
+        arms a watchdog that records workers silent on the PS heartbeat
+        beyond the timeout into ``history['detected_idle_workers']`` —
+        the detection signal; the retry/elastic machinery is the
+        action."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -425,14 +430,20 @@ class DistributedTrainer(Trainer):
         self.max_worker_failures = int(max_worker_failures)
         self.worker_retries = int(worker_retries)
         self.fault_injector = fault_injector
+        self.worker_timeout = (None if worker_timeout is None
+                               else float(worker_timeout))
+        if self.worker_timeout is not None and self.worker_timeout <= 0:
+            raise ValueError(
+                f"worker_timeout must be positive, got {worker_timeout}")
         if fidelity != "host" and (self.max_worker_failures
                                    or self.worker_retries
+                                   or self.worker_timeout is not None
                                    or fault_injector is not None):
             raise ValueError(
-                "max_worker_failures / worker_retries / fault_injector "
-                "apply only to fidelity='host' (the emulated arms are "
-                f"deterministic; recover via checkpoint/resume), got "
-                f"fidelity={fidelity!r}")
+                "max_worker_failures / worker_retries / worker_timeout "
+                "/ fault_injector apply only to fidelity='host' (the "
+                "emulated arms are deterministic; recover via "
+                f"checkpoint/resume), got fidelity={fidelity!r}")
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
@@ -847,8 +858,39 @@ class DistributedTrainer(Trainer):
                    for w in range(num_workers)]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join()
+        # Active failure detection (SURVEY.md §5): while workers run, a
+        # watchdog samples the PS contact heartbeat and records any
+        # worker silent beyond worker_timeout — the monitoring signal an
+        # operator would page on; the join + elastic machinery below is
+        # the corresponding action.
+        detected: list[list[int]] = []
+        watcher = None
+        stop_watch = threading.Event()
+        if self.worker_timeout is not None:
+            for w in range(num_workers):
+                # monitor from t=0: a worker hanging before its first
+                # PS contact must be flagged, not invisible
+                ps.register(w)
+
+            def watchdog():
+                while not stop_watch.wait(self.worker_timeout / 4):
+                    idle = ps.idle_workers(self.worker_timeout)
+                    if idle and (not detected or detected[-1] != idle):
+                        detected.append(idle)
+
+            watcher = threading.Thread(target=watchdog, daemon=True)
+            watcher.start()
+        try:
+            for t in threads:
+                t.join()
+        finally:
+            # always reap the watchdog — a KeyboardInterrupt in join()
+            # must not leak a thread polling the PS forever
+            stop_watch.set()
+            if watcher is not None:
+                watcher.join()
+        if detected:
+            self._record(detected_idle_workers=detected)
         if server is not None:
             server.stop()
         if failures and (len(failures) > self.max_worker_failures

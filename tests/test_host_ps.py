@@ -326,3 +326,34 @@ def test_retire_removes_liveness_and_reply_cache():
     # retry kwargs are host-arm only
     with pytest.raises(ValueError, match="fidelity='host'"):
         DOWNPOUR(MLP, worker_retries=2)
+
+
+def test_watchdog_detects_stalled_worker():
+    """worker_timeout arms the liveness watchdog: a worker stalled
+    mid-round shows up in history['detected_idle_workers']."""
+    import time as _time
+
+    stalled = {"armed": True}
+
+    def injector(w, epoch, r):
+        if w == 1 and epoch == 0 and r == 1 and stalled.pop("armed",
+                                                            False):
+            _time.sleep(2.5)
+
+    t = DOWNPOUR(MLP, fidelity="host", num_workers=3,
+                 communication_window=2, batch_size=16, num_epoch=1,
+                 learning_rate=0.01, worker_timeout=0.5,
+                 fault_injector=injector)
+    t.train(DATA)
+    detected = t.history.get("detected_idle_workers", [[]])[-1]
+    assert any(1 in idle for idle in detected), detected
+    # the stall was transient: training still completed every round
+    assert t.parameter_server_state.num_commits == \
+        len(t.history["round_loss"])
+
+
+def test_worker_timeout_host_only_and_positive():
+    with pytest.raises(ValueError, match="fidelity='host'"):
+        DOWNPOUR(MLP, worker_timeout=5.0)
+    with pytest.raises(ValueError, match="positive"):
+        DOWNPOUR(MLP, fidelity="host", worker_timeout=0.0)
