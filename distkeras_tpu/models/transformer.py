@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from distkeras_tpu.models.core import register_model
+from distkeras_tpu.parallel.moe import expert_capacity, routing
 
 AttnFn = Callable[..., jnp.ndarray]
 
@@ -52,11 +53,71 @@ class SelfAttention(nn.Module):
                                name="out")(out)
 
 
+class MoEFFN(nn.Module):
+    """Mixture-of-experts FFN in the dense einsum (GShard/Mesh-TF)
+    form: every expert-dim op is a batched matmul over ``E``, so
+    sharding the parameters' leading expert axis (see
+    ``parallel.tensor_parallel.TRANSFORMER_TP_RULES``) makes GSPMD
+    derive the expert-parallel communication — no ``shard_map``
+    needed, and the same module runs replicated on one device.
+
+    Routing reuses ``parallel.moe._routing`` (top-k, capacity
+    bucketing, f32 bookkeeping).  The load-balancing auxiliary loss is
+    sown into the ``"losses"`` collection, which
+    ``workers.make_train_step`` adds to the objective."""
+
+    num_experts: int
+    mlp_ratio: int
+    dtype: jnp.dtype
+    capacity_factor: float = 1.25
+    top_k: int = 1
+    aux_loss_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        h = d * self.mlp_ratio
+        e = self.num_experts
+        if not 1 <= self.top_k <= e:
+            raise ValueError(
+                f"expert_top_k={self.top_k} out of range [1, {e}]")
+        tokens = x.reshape(b * t, d)
+        capacity = expert_capacity(b * t, e, self.capacity_factor,
+                                   self.top_k)
+        router = self.param(
+            "router", nn.initializers.normal(d ** -0.5), (d, e))
+        w_in = self.param(
+            "w_in", nn.initializers.normal(d ** -0.5), (e, d, h))
+        b_in = self.param("b_in", nn.initializers.zeros, (e, h))
+        w_out = self.param(
+            "w_out", nn.initializers.normal(h ** -0.5), (e, h, d))
+        b_out = self.param("b_out", nn.initializers.zeros, (e, d))
+
+        dispatch, combine, aux = routing(
+            tokens.astype(self.dtype), router, e, capacity, self.top_k)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                               tokens.astype(self.dtype))
+        hidden = nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in,
+                       w_in.astype(self.dtype))
+            + b_in.astype(self.dtype)[:, None])
+        out = (jnp.einsum("ech,ehd->ecd", hidden,
+                          w_out.astype(self.dtype))
+               + b_out.astype(self.dtype)[:, None])
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        self.sow("losses", "moe_load_balance",
+                 self.aux_loss_weight * aux.load_balance_loss)
+        return y.reshape(b, t, d)
+
+
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int
     dtype: jnp.dtype
     attn_fn: Optional[AttnFn] = None
+    num_experts: int = 0  # 0 = dense MLP; >0 = MoE FFN
+    expert_capacity_factor: float = 1.25
+    expert_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -64,9 +125,14 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn)(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(d_model, dtype=self.dtype)(y)
+        if self.num_experts > 0:
+            y = MoEFFN(self.num_experts, self.mlp_ratio, self.dtype,
+                       self.expert_capacity_factor, self.expert_top_k,
+                       name="moe")(y)
+        else:
+            y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(d_model, dtype=self.dtype)(y)
         return x + y
 
 
@@ -92,6 +158,13 @@ class TransformerLM(nn.Module):
     # within-device q block length for ring attention (None = full
     # block); see parallel.ring_attention.ring_attention(q_chunk=)
     attn_q_chunk: Optional[int] = None
+    # >0 replaces every block's MLP with a mixture-of-experts FFN
+    # (dense einsum form — shard the expert axes via the TP rules for
+    # expert parallelism); the load-balance aux loss rides the
+    # "losses" collection into the training objective
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    expert_top_k: int = 1
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -122,7 +195,9 @@ class TransformerLM(nn.Module):
                        name="pos_embed")(positions)
         x = x + pos
         for _ in range(self.num_layers):
-            x = Block(self.num_heads, self.mlp_ratio, dtype, attn_fn)(x)
+            x = Block(self.num_heads, self.mlp_ratio, dtype, attn_fn,
+                      self.num_experts, self.expert_capacity_factor,
+                      self.expert_top_k)(x)
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)

@@ -79,7 +79,16 @@ class MoEAux(NamedTuple):
     dropped_fraction: jax.Array   # scalar in [0, 1]
 
 
-def _routing(x, router, num_experts, capacity, top_k=1):
+def expert_capacity(num_tokens: int, num_experts: int,
+                    capacity_factor: float, top_k: int = 1) -> int:
+    """Per-expert bucket size: ``ceil(T * k * factor / E)``, min 1 —
+    the one capacity policy shared by ``moe_apply`` and the model-zoo
+    ``MoEFFN``."""
+    return max(1, math.ceil(
+        num_tokens * top_k * capacity_factor / num_experts))
+
+
+def routing(x, router, num_experts, capacity, top_k=1):
     """Top-k dispatch/combine tensors ([T, E, C]) + aux telemetry.
 
     ``top_k=1`` is the Switch layer; ``top_k=2`` the GShard-style
@@ -148,11 +157,11 @@ def moe_apply(params: MoEParams, x: jax.Array, *, axis_name: str,
         raise ValueError(
             f"top_k={top_k} out of range [1, {num_experts}]")
     t_local, d = x.shape
-    capacity = max(1, math.ceil(
-        t_local * top_k * capacity_factor / num_experts))
+    capacity = expert_capacity(t_local, num_experts, capacity_factor,
+                               top_k)
 
-    dispatch, combine, aux = _routing(x, params.router, num_experts,
-                                      capacity, top_k)
+    dispatch, combine, aux = routing(x, params.router, num_experts,
+                                     capacity, top_k)
 
     # [T, E, C] -> expert-major input buckets [E, C, d]
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)

@@ -48,6 +48,11 @@ TRANSFORMER_TP_RULES: Sequence[Rule] = (
     # LM head column-parallel over the vocabulary.
     (r"lm_head/kernel$", P(None, MODEL_AXIS)),
     (r"lm_head/bias$", P(MODEL_AXIS)),
+    # MoE FFN: experts sharded over the model axis (expert parallelism
+    # via GSPMD — the dense-einsum MoEFFN's expert-dim batched matmuls
+    # partition on E); router replicated (no rule).
+    (r"moe/(w_in|w_out)$", P(MODEL_AXIS, None, None)),
+    (r"moe/(b_in|b_out)$", P(MODEL_AXIS, None)),
 )
 
 

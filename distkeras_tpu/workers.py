@@ -108,29 +108,51 @@ def make_train_step(model, loss, tx: optax.GradientTransformation,
     def step(state: TrainState, batch: Mapping[str, jnp.ndarray]):
         x, y = batch[features_col], batch[label_col]
         rng = jax.random.fold_in(state.rng, state.step)
-        mutable_keys = list(state.model_state)
+        # "losses" is ALWAYS mutable — auxiliary objectives sown by
+        # modules (e.g. the MoE load-balance loss) must reach the
+        # objective even when the caller built the state from
+        # params-only variables (no init-time "losses" entry), or they
+        # would be dropped silently.
+        carried_keys = list(state.model_state)
+        mutable_keys = carried_keys + (
+            [] if "losses" in carried_keys else ["losses"])
 
         def objective(params):
-            variables = {"params": params, **state.model_state}
-            if mutable_keys:
-                logits, new_model_state = model.apply(
-                    variables, x, train=True, rngs={"dropout": rng},
-                    mutable=mutable_keys)
-            else:
-                logits = model.apply(variables, x, train=True,
-                                     rngs={"dropout": rng})
-                new_model_state = state.model_state
-            return loss_fn(logits, y), new_model_state
+            # "losses" is stripped from the INPUT so each apply sows a
+            # fresh, shape-stable collection — flax sow would otherwise
+            # append to the carried tuples every step, breaking the
+            # scan carry.
+            model_state_in = {k: v for k, v in state.model_state.items()
+                              if k != "losses"}
+            variables = {"params": params, **model_state_in}
+            logits, new_model_state = model.apply(
+                variables, x, train=True, rngs={"dropout": rng},
+                mutable=mutable_keys)
+            new_model_state = dict(new_model_state)
+            aux_sum = jnp.float32(0.0)
+            for leaf in jax.tree_util.tree_leaves(
+                    new_model_state.get("losses", {})):
+                aux_sum = aux_sum + leaf
+            if "losses" not in carried_keys:
+                # keep the carry's structure identical to the input
+                # state (scan requires it)
+                new_model_state.pop("losses", None)
+            task_loss = loss_fn(logits, y)
+            return task_loss + aux_sum, (task_loss, aux_sum,
+                                         new_model_state)
 
-        (loss_val, new_model_state), grads = jax.value_and_grad(
+        ((loss_val, (task_loss, aux_sum, new_model_state)),
+         grads) = jax.value_and_grad(
             objective, has_aux=True)(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state,
                                            state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(step=state.step + 1, params=new_params,
                                   opt_state=new_opt_state,
-                                  model_state=dict(new_model_state))
-        metrics = {"loss": loss_val,
+                                  model_state=new_model_state)
+        # "loss" stays the task loss (comparable with eval loss and
+        # aux-free runs); the auxiliary sum is reported separately.
+        metrics = {"loss": task_loss, "aux_loss": aux_sum,
                    "grad_norm": optax.global_norm(grads)}
         return new_state, metrics
 
