@@ -126,3 +126,30 @@ def test_bad_expert_top_k_raises():
     with pytest.raises(ValueError, match="expert_top_k"):
         spec.build().init(jax.random.key(0),
                           np.zeros((2, 16), np.int32))
+
+
+def test_moe_composes_with_sequence_parallelism(devices):
+    """TransformerLM(seq_axis=..., num_experts=...): ring attention over
+    the mesh with per-device local MoE routing — matches the dense
+    single-device MoE model exactly when capacity doesn't bind."""
+    from distkeras_tpu.parallel.ring_attention import (
+        sequence_sharded_apply)
+    from jax.sharding import Mesh
+
+    cfg = dict(input_dtype="int32", vocab_size=32, num_layers=2,
+               d_model=32, num_heads=4, max_len=32, dtype="float32",
+               num_experts=4, expert_capacity_factor=4.0)
+    dense = ModelSpec.from_config(
+        model_config("transformer_lm", (32,), **cfg)).build()
+    seq = ModelSpec.from_config(
+        model_config("transformer_lm", (32,), seq_axis="seq",
+                     **cfg)).build()
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("seq",))
+
+    tokens = jax.random.randint(jax.random.key(6), (2, 32), 0, 32)
+    variables = dense.init(jax.random.key(7), tokens)
+    want = np.asarray(dense.apply(variables, tokens))
+    sp = sequence_sharded_apply(
+        lambda vs, toks: seq.apply(vs, toks), mesh, "seq")
+    got = np.asarray(jax.jit(sp)(variables, tokens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
