@@ -59,6 +59,52 @@ def make_parser(description: str, **defaults) -> argparse.ArgumentParser:
     return p
 
 
+def add_data_option(p: argparse.ArgumentParser,
+                    required=("features", "label")):
+    """Opt-in ``--data-npz`` for scripts that honor it via
+    ``load_dataset`` (only those — a flag every script parses but most
+    ignore would silently train on synthetic data).  ``required`` names
+    the archive columns, single-sourced: it feeds both the help text
+    and ``load_dataset``'s validation (via a parser default)."""
+    p.set_defaults(_npz_required=tuple(required))
+    p.add_argument("--data-npz", default=None, metavar="FILE",
+                   help="train on real data from an .npz archive "
+                        "instead of synthetic: each array becomes a "
+                        f"Dataset column (needs {list(required)})")
+    return p
+
+
+def load_dataset(args, synth_fn, required=None, shuffle_seed=None):
+    """The example's dataset: ``--data-npz FILE`` (real data, no egress
+    needed — any locally produced archive works) or the config's
+    synthetic fallback ``synth_fn()``.  Real archives are shuffled
+    (seeded) so ordered rows — e.g. grouped by class — don't skew
+    contiguous train/holdout splits.  ``required`` defaults to what
+    ``add_data_option`` registered; pass it explicitly only when the
+    real requirement depends on other args."""
+    if args.data_npz is None:
+        return synth_fn()
+    if required is None:
+        required = getattr(args, "_npz_required",
+                           ("features", "label"))
+    import numpy as np
+
+    from distkeras_tpu.data.dataset import Dataset
+
+    with np.load(args.data_npz) as archive:
+        columns = {k: np.asarray(archive[k]) for k in archive.files}
+    missing = [c for c in required if c not in columns]
+    if missing:
+        raise SystemExit(
+            f"--data-npz {args.data_npz}: missing required "
+            f"column(s) {missing}; found {sorted(columns)}")
+    print(f"[data] loaded {args.data_npz}: "
+          + ", ".join(f"{k}{tuple(v.shape)}"
+                      for k, v in sorted(columns.items())))
+    return Dataset(columns).shuffle(
+        seed=args.seed if shuffle_seed is None else shuffle_seed)
+
+
 def parse_args_and_setup(parser: argparse.ArgumentParser):
     """Parse args and, if requested, force a virtual CPU mesh.
 

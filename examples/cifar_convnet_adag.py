@@ -12,12 +12,14 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report)
 
 
 def main():
     parser = make_parser(__doc__, rows=2048, epochs=2, batch_size=16,
                          workers=4, window=2, learning_rate=0.02)
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     import numpy as np
@@ -27,7 +29,9 @@ def main():
     from distkeras_tpu.models import model_config
     from distkeras_tpu.trainers import ADAG
 
-    data = datasets.cifar10_synth(args.rows, seed=args.seed + 1)
+    data = load_dataset(
+        args,
+        lambda: datasets.cifar10_synth(args.rows, seed=args.seed + 1))
     cfg = model_config("convnet", (32, 32, 3), num_classes=10,
                        widths=(16, 32), dense=64)
     trainer = ADAG(cfg, num_workers=args.workers,

@@ -13,12 +13,14 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup)
 
 
 def main():
     parser = make_parser(__doc__, rows=4096, epochs=2, batch_size=32,
                          workers=4, window=2, learning_rate=3e-3)
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     from distkeras_tpu import trainers
@@ -26,7 +28,9 @@ def main():
     from distkeras_tpu.evaluators import evaluate_model
     from distkeras_tpu.models import model_config
 
-    data = datasets.mnist_synth(args.rows, seed=args.seed)
+    data = load_dataset(
+        args, lambda: datasets.mnist_synth(args.rows,
+                                           seed=args.seed))
     cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
     common = dict(worker_optimizer="adam",
                   learning_rate=args.learning_rate,

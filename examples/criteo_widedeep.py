@@ -12,7 +12,9 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report, timed
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report,
+                     timed)
 
 
 def main():
@@ -21,6 +23,9 @@ def main():
     parser.add_argument("--num-dense", type=int, default=4)
     parser.add_argument("--num-categorical", type=int, default=6)
     parser.add_argument("--buckets", type=int, default=50)
+    add_data_option(parser,
+                    required=("dense", "label",
+                              "c0..c{num_categorical-1}"))
     args = parse_args_and_setup(parser)
 
     from distkeras_tpu.data import (
@@ -36,9 +41,14 @@ def main():
     from distkeras_tpu.trainers import DOWNPOUR
 
     nd, nc = args.num_dense, args.num_categorical
-    data = datasets.criteo_synth(args.rows, num_dense=nd,
-                                 num_categorical=nc, vocab_size=100,
-                                 seed=args.seed + 4)
+    data = load_dataset(
+        args,
+        lambda: datasets.criteo_synth(args.rows, num_dense=nd,
+                                      num_categorical=nc,
+                                      vocab_size=100,
+                                      seed=args.seed + 4),
+        required=("dense", "label")
+        + tuple(f"c{j}" for j in range(nc)))
     with timed("criteo_etl"):
         etl = Pipeline(
             [MinMaxTransformer("dense")]

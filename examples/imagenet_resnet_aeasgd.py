@@ -13,7 +13,8 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report)
 
 
 def main():
@@ -27,6 +28,7 @@ def main():
                         help="elastic force (alpha = lr * rho)")
     parser.add_argument("--fidelity", choices=("faithful", "fast"),
                         default="faithful")
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     from distkeras_tpu.data import datasets
@@ -34,9 +36,11 @@ def main():
     from distkeras_tpu.models import model_config
     from distkeras_tpu.trainers import AEASGD
 
-    data = datasets.imagenet_synth(args.rows, image_size=args.image_size,
-                                   num_classes=args.num_classes,
-                                   seed=args.seed + 2)
+    data = load_dataset(
+        args,
+        lambda: datasets.imagenet_synth(
+            args.rows, image_size=args.image_size,
+            num_classes=args.num_classes, seed=args.seed + 2))
     stages = (2, 2, 2, 2) if args.resnet == 18 else (3, 4, 6, 3)
     cfg = model_config("resnet",
                        (args.image_size, args.image_size, 3),

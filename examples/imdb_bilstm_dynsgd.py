@@ -10,7 +10,8 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report)
 
 
 def main():
@@ -18,6 +19,7 @@ def main():
                          workers=4, window=2, learning_rate=0.01)
     parser.add_argument("--seq-len", type=int, default=32)
     parser.add_argument("--vocab-size", type=int, default=200)
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     from distkeras_tpu.data import datasets
@@ -25,9 +27,11 @@ def main():
     from distkeras_tpu.models import model_config
     from distkeras_tpu.trainers import DynSGD
 
-    data = datasets.imdb_synth(args.rows, seq_len=args.seq_len,
-                               vocab_size=args.vocab_size,
-                               seed=args.seed + 3)
+    data = load_dataset(
+        args,
+        lambda: datasets.imdb_synth(
+            args.rows, seq_len=args.seq_len,
+            vocab_size=args.vocab_size, seed=args.seed + 3))
     cfg = model_config("bilstm", (args.seq_len,), input_dtype="int32",
                        vocab_size=args.vocab_size, embed_dim=16,
                        hidden_dim=16, num_classes=2)

@@ -17,7 +17,8 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report)
 
 TRAINERS = ("single", "sync", "downpour", "adag")
 
@@ -41,6 +42,7 @@ def main():
     parser = make_parser(__doc__, rows=4096, epochs=3, batch_size=64,
                          learning_rate=3e-3)
     parser.add_argument("--trainer", choices=TRAINERS, default="sync")
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     from distkeras_tpu import trainers
@@ -67,7 +69,9 @@ def main():
     print(f"[keras_import] ingested from {source}: "
           f"{[l['kind'] for l in spec.kwargs['layers']]}")
 
-    data = datasets.mnist_synth(args.rows, seed=args.seed)
+    data = load_dataset(
+        args, lambda: datasets.mnist_synth(args.rows,
+                                           seed=args.seed))
     holdout, train = data.shard(4, 0), data.shard(4, 1).concat(
         data.shard(4, 2)).concat(data.shard(4, 3))
 

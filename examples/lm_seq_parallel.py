@@ -14,7 +14,8 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
-from _common import make_parser, parse_args_and_setup, report
+from _common import (add_data_option, load_dataset,
+                     make_parser, parse_args_and_setup, report)
 
 
 def main():
@@ -28,6 +29,7 @@ def main():
                         help="within-device q block length for ring "
                              "attention (bounds transient memory to "
                              "[q_chunk, T_local] per hop)")
+    add_data_option(parser)
     args = parse_args_and_setup(parser)
 
     import time
@@ -53,9 +55,12 @@ def main():
             f"sequence length {t_local}")
     mesh = Mesh(np.asarray(jax.devices()), ("seq",))
 
-    data = datasets.lm_synth(args.rows, seq_len=args.seq_len,
-                             vocab_size=args.vocab_size,
-                             seed=args.seed)
+    data = load_dataset(
+        args, lambda: datasets.lm_synth(args.rows,
+                                        seq_len=args.seq_len,
+                                        vocab_size=args.vocab_size,
+                                        seed=args.seed))
+    rows = len(data)
     lm_cfg = dict(vocab_size=args.vocab_size, num_layers=args.layers,
                   d_model=args.d_model, num_heads=4,
                   max_len=args.seq_len, dtype="float32")
@@ -93,19 +98,19 @@ def main():
 
     start = time.time()
     epoch_losses = []
-    steps_per_epoch = args.rows // args.batch_size
+    steps_per_epoch = rows // args.batch_size
     if not steps_per_epoch:
-        raise SystemExit(f"--rows {args.rows} < --batch-size "
+        raise SystemExit(f"--rows {rows} < --batch-size "
                          f"{args.batch_size}: no full batch to train on")
     for epoch in range(args.epochs):
         order = np.random.default_rng(args.seed + epoch).permutation(
-            args.rows)
+            rows)
         losses = []
         for s in range(steps_per_epoch):
-            rows = order[s * args.batch_size:(s + 1) * args.batch_size]
+            idx = order[s * args.batch_size:(s + 1) * args.batch_size]
             variables, opt_state, loss = step(
-                variables, opt_state, data["features"][rows],
-                data["label"][rows])
+                variables, opt_state, data["features"][idx],
+                data["label"][idx])
             losses.append(float(loss))
         epoch_losses.append(float(np.mean(losses)))
         print(f"[lm_seq_parallel] epoch {epoch}: "
