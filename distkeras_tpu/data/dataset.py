@@ -53,6 +53,88 @@ class Dataset:
         shapes = {k: v.shape for k, v in self._columns.items()}
         return f"Dataset(rows={self._num_rows}, columns={shapes})"
 
+    # -- IO ---------------------------------------------------------------
+
+    @classmethod
+    def from_csv(cls, path, *, delimiter: str = ",",
+                 header: bool = True,
+                 names: Sequence[str] | None = None) -> "Dataset":
+        """Read a delimited text file into typed columns.
+
+        The reference ingested CSVs through Spark's reader (its Criteo/
+        ATLAS notebooks); here each column is auto-typed: all-numeric
+        columns become f32 (ints stay int64), anything else a numpy
+        string column — ready for ``LabelIndexTransformer`` /
+        ``HashBucketTransformer``.  ``names`` overrides or supplies the
+        column names (required when ``header=False``); plain unquoted
+        CSV/TSV only.
+        """
+        import csv as _csv
+
+        with open(path, newline="") as fh:
+            reader = _csv.reader(fh, delimiter=delimiter)
+            rows = [row for row in reader if row]
+        if not rows:
+            raise ValueError(f"{path}: empty file")
+        if header:
+            file_names, rows = rows[0], rows[1:]
+            names = list(names) if names is not None else file_names
+        elif names is None:
+            raise ValueError("header=False needs explicit names=")
+        else:
+            names = list(names)
+        if not rows:
+            raise ValueError(f"{path}: no data rows")
+        widths = {len(r) for r in rows}
+        if widths != {len(names)}:
+            raise ValueError(
+                f"{path}: rows have {sorted(widths)} fields, "
+                f"expected {len(names)}")
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"{path}: duplicate column name(s) {sorted(dupes)}")
+
+        def typed(values: list[str]) -> np.ndarray:
+            try:
+                return np.asarray([int(v) for v in values],
+                                  dtype=np.int64)
+            except (ValueError, OverflowError):
+                # OverflowError: ids past int64 fall through to the
+                # float/string paths instead of crashing
+                pass
+            try:
+                return np.asarray([float(v) for v in values],
+                                  dtype=np.float32)
+            except ValueError:
+                return np.asarray(values)
+
+        return cls({name: typed([r[c] for r in rows])
+                    for c, name in enumerate(names)})
+
+    @classmethod
+    def from_npz(cls, path) -> "Dataset":
+        """Read an ``.npz`` archive: each array becomes a column."""
+        with np.load(path) as archive:
+            return cls({k: np.asarray(archive[k])
+                        for k in archive.files})
+
+    def to_npz(self, path) -> str:
+        """Write all columns to an ``.npz`` archive (the format the
+        examples' ``--data-npz`` flag reads).  Returns the actual file
+        path (numpy appends ``.npz`` when missing).  A column named
+        ``file`` is rejected — it collides with ``np.savez``'s
+        parameter and cannot be stored by keyword."""
+        if "file" in self._columns:
+            raise ValueError(
+                "cannot write a column named 'file' to npz (collides "
+                "with np.savez's parameter); rename() it first")
+        path = str(path)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        np.savez(path, **self._columns)
+        return path
+
     # -- DataFrame-shaped verbs -------------------------------------------
 
     def select(self, names: Sequence[str]) -> "Dataset":

@@ -169,3 +169,99 @@ def test_hash_bucket_vectorized_matches_scalar():
     vec = t._fnv1a_vectorized(values)
     for v, h in zip(values, vec):
         assert int(h) == t._fnv1a(str(v).encode("utf-8")), v
+
+
+def test_dataset_csv_roundtrip_and_typing(tmp_path):
+    """CSV ingestion (the reference's Spark-reader surface): numeric
+    columns auto-type (int64 / f32), strings stay strings."""
+    from distkeras_tpu.data.dataset import Dataset
+
+    p = tmp_path / "t.csv"
+    p.write_text("id,score,cat\n1,0.5,a\n2,1.5,b\n3,-2.0,a\n")
+    ds = Dataset.from_csv(p)
+    assert ds.column_names == ["id", "score", "cat"]
+    assert ds["id"].dtype == np.int64
+    assert ds["score"].dtype == np.float32
+    assert ds["cat"].dtype.kind in ("U", "S")
+    np.testing.assert_allclose(ds["score"], [0.5, 1.5, -2.0])
+
+    # headerless TSV with explicit names
+    q = tmp_path / "t.tsv"
+    q.write_text("1\tx\n2\ty\n")
+    ds2 = Dataset.from_csv(q, delimiter="\t", header=False,
+                           names=["n", "s"])
+    assert len(ds2) == 2 and list(ds2["s"]) == ["x", "y"]
+
+    # npz round trip (the --data-npz example format)
+    out = ds.drop("cat").to_npz(tmp_path / "t.npz")
+    back = Dataset.from_npz(out)
+    np.testing.assert_array_equal(back["id"], ds["id"])
+
+
+def test_dataset_csv_errors(tmp_path):
+    from distkeras_tpu.data.dataset import Dataset
+
+    bad = tmp_path / "bad.csv"
+    bad.write_text("a,b\n1,2\n3\n")
+    with pytest.raises(ValueError, match="fields"):
+        Dataset.from_csv(bad)
+    with pytest.raises(ValueError, match="names"):
+        Dataset.from_csv(bad, header=False)
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="empty"):
+        Dataset.from_csv(empty)
+
+
+def test_csv_to_training_pipeline(tmp_path):
+    """CSV -> ETL -> trainer end-to-end (the reference's notebook
+    flow: read file, transform, train)."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.data.transformers import (AssembleTransformer,
+                                                 LabelIndexTransformer)
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import SingleTrainer
+
+    rng = np.random.default_rng(0)
+    lines = ["f0,f1,f2,f3,label"]
+    for i in range(256):
+        cls = "pos" if rng.normal() > 0 else "neg"
+        feats = rng.normal(size=4) + (1.0 if cls == "pos" else -1.0)
+        lines.append(",".join(f"{v:.4f}" for v in feats) + "," + cls)
+    p = tmp_path / "train.csv"
+    p.write_text("\n".join(lines) + "\n")
+
+    ds = Dataset.from_csv(p)
+    ds = LabelIndexTransformer("label").fit_transform(ds)
+    ds = AssembleTransformer(
+        ["f0", "f1", "f2", "f3"], output_col="features")(ds)
+    ds = ds.drop("label").rename({"label_index": "label"})
+    t = SingleTrainer(model_config("mlp", (4,), num_classes=2,
+                                   hidden=(8,)),
+                      worker_optimizer="adam", learning_rate=1e-2,
+                      batch_size=32, num_epoch=3)
+    t.train(ds)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0] * 0.8, h
+
+
+def test_csv_edge_cases(tmp_path):
+    from distkeras_tpu.data.dataset import Dataset
+
+    # duplicate header names rejected (would silently drop a column)
+    dup = tmp_path / "dup.csv"
+    dup.write_text("a,a\n1,2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        Dataset.from_csv(dup)
+    # int64 overflow falls through to float, not a crash
+    big = tmp_path / "big.csv"
+    big.write_text("id\n12345678901234567890123\n1\n")
+    ds = Dataset.from_csv(big)
+    assert ds["id"].dtype == np.float32
+    # to_npz appends .npz and returns the real path
+    out = Dataset({"x": np.ones(3)}).to_npz(tmp_path / "plain")
+    assert out.endswith("plain.npz")
+    assert len(Dataset.from_npz(out)) == 3
+    # reserved column name
+    with pytest.raises(ValueError, match="file"):
+        Dataset({"file": np.ones(2)}).to_npz(tmp_path / "f")
