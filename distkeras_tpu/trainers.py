@@ -455,12 +455,6 @@ class DistributedTrainer(Trainer):
                     "fidelity='host' is the nondeterministic faithful "
                     "arm; checkpoint/resume of racing threads is not "
                     "supported — use the emulated fidelities")
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "fidelity='host' runs one in-process PS per "
-                    "process and would train divergent replicas "
-                    "multi-host; use the emulated fidelities (or a "
-                    "single process with transport='socket')")
             return self._train_host(dataset, initial_variables)
         if jax.process_count() > 1 and (self.checkpoint_dir
                                         or resume_from):
@@ -660,7 +654,14 @@ class DistributedTrainer(Trainer):
         """Design 5a (SURVEY.md §7): free-running worker threads against
         a concurrent host-side parameter server.  Real races, emergent
         staleness — the faithful arm the on-mesh emulator's deterministic
-        staleness is validated against.  See ``parallel.host_ps``."""
+        staleness is validated against.  See ``parallel.host_ps``.
+
+        Multi-host (``transport='socket'`` required): process 0 hosts
+        the PS, every process runs its slice of the worker ids, and the
+        reference's star topology spans hosts over real TCP — the DCN
+        arm.  The PS address travels by collective broadcast; the final
+        center, staleness log, and epoch telemetry are broadcast/
+        reduced so every process returns identical results."""
         import threading
 
         from distkeras_tpu.parallel.host_ps import (
@@ -676,14 +677,60 @@ class DistributedTrainer(Trainer):
         num_workers = self.num_workers
         window = self.communication_window
 
-        ps = HostParameterServer(rule, center)
-        server = None
-        if self.transport == "socket":
-            server = PSServer(ps, center).start()
-        elif self.transport != "inprocess":
+        if self.transport not in ("inprocess", "socket"):
             raise ValueError(
                 f"unknown transport {self.transport!r}; "
                 "expected 'inprocess' or 'socket'")
+        pc = jax.process_count()
+        rank = jax.process_index()
+        multi = pc > 1
+        if multi:
+            from jax.experimental import multihost_utils
+            if self.transport != "socket":
+                raise ValueError(
+                    "multi-host fidelity='host' needs "
+                    "transport='socket' (the PS lives on process 0)")
+            if num_workers % pc:
+                raise ValueError(
+                    f"multi-host needs num_workers ({num_workers}) "
+                    f"divisible by the process count ({pc})")
+
+        ps = None
+        server = None
+        if not multi or rank == 0:
+            ps = HostParameterServer(rule, center)
+            if self.transport == "socket":
+                server = PSServer(
+                    ps, center,
+                    host="0.0.0.0" if multi else "127.0.0.1").start()
+        if multi:
+            # ship process 0's "host:port" to everyone (fixed-width
+            # byte buffer: broadcast needs one shape on all processes)
+            wire = np.zeros(64, np.uint8)
+            if rank == 0:
+                import os as _os
+
+                from distkeras_tpu.parallel import transport as _tp
+
+                ps_host = (_os.environ.get("DKT_PS_HOST")
+                           or _tp.determine_host_address())
+                if ps_host.startswith("127."):
+                    # correct for single-machine multi-process (the
+                    # local[N] analogue); a real pod must override
+                    print("[distkeras_tpu] PS address resolved to "
+                          f"loopback ({ps_host}) — fine for processes "
+                          "on one machine; set DKT_PS_HOST to a "
+                          "routable address for true multi-host",
+                          flush=True)
+                addr = f"{ps_host}:{server.address[1]}".encode()
+                wire[:len(addr)] = np.frombuffer(addr, np.uint8)
+            wire = np.asarray(
+                multihost_utils.broadcast_one_to_all(wire))
+            host_s, _, port_s = bytes(
+                wire).rstrip(b"\0").decode().rpartition(":")
+            ps_address = (host_s, int(port_s))
+        else:
+            ps_address = server.address if server is not None else None
 
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
@@ -703,7 +750,14 @@ class DistributedTrainer(Trainer):
         # fetches them.
         shard_lock = threading.Lock()
         shard_cache: dict[int, tuple[list, set]] = {}
-        dead_workers: set[int] = set()
+        per_proc = num_workers // pc
+        local_workers = (range(rank * per_proc, (rank + 1) * per_proc)
+                         if multi else range(num_workers))
+        # workers this process will never run (multi-host slices) count
+        # as "never fetching" for the shard-cache sweep, or every
+        # epoch's repartition would stay pinned in memory
+        dead_workers: set[int] = (set(range(num_workers))
+                                  - set(local_workers))
         dropped_per_epoch = [0] * self.num_epoch
 
         def _sweep_shard_cache():
@@ -738,8 +792,8 @@ class DistributedTrainer(Trainer):
 
             def connect():
                 nonlocal client
-                if server is not None:
-                    client = PSClient(*server.address, worker_id=w,
+                if ps_address is not None:
+                    client = PSClient(*ps_address, worker_id=w,
                                       template=center)
                     return client.pull, client.commit
                 # In-process commits are atomic (apply-and-return under
@@ -855,7 +909,7 @@ class DistributedTrainer(Trainer):
                 failures.append((w, e))
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
-                   for w in range(num_workers)]
+                   for w in local_workers]
         for t in threads:
             t.start()
         # Active failure detection (SURVEY.md §5): while workers run, a
@@ -866,7 +920,7 @@ class DistributedTrainer(Trainer):
         detected: list[list[int]] = []
         watcher = None
         stop_watch = threading.Event()
-        if self.worker_timeout is not None:
+        if self.worker_timeout is not None and ps is not None:
             for w in range(num_workers):
                 # monitor from t=0: a worker hanging before its first
                 # PS contact must be flagged, not invisible
@@ -883,6 +937,12 @@ class DistributedTrainer(Trainer):
         try:
             for t in threads:
                 t.join()
+            if multi:
+                # the PS (and its watchdog — remote workers may still
+                # be running and must stay monitored) must outlive
+                # every process's workers
+                multihost_utils.sync_global_devices(
+                    "dkt-host-ps-drained")
         finally:
             # always reap the watchdog — a KeyboardInterrupt in join()
             # must not leak a thread polling the PS forever
@@ -893,9 +953,18 @@ class DistributedTrainer(Trainer):
             self._record(detected_idle_workers=detected)
         if server is not None:
             server.stop()
-        if failures and (len(failures) > self.max_worker_failures
-                         or len(failures) == num_workers):
-            raise failures[0][1]
+        total_failures = len(failures)
+        if multi:
+            total_failures = int(multihost_utils.process_allgather(
+                np.asarray([len(failures)])).sum())
+        if total_failures and (total_failures > self.max_worker_failures
+                               or total_failures == num_workers):
+            if failures:
+                raise failures[0][1]
+            raise RuntimeError(
+                f"{total_failures} worker(s) failed on other "
+                f"processes (> max_worker_failures="
+                f"{self.max_worker_failures})")
         if failures:
             # Elastic continuation: the dead workers' committed rounds
             # stay in the center (durable by construction); survivors
@@ -905,16 +974,46 @@ class DistributedTrainer(Trainer):
         if retry_records:
             self._record(worker_round_retries=list(retry_records))
 
+        # round_loss is per-process telemetry (this process's workers);
+        # epoch_loss / dropped tails are reduced globally so every
+        # process reports identical curves.
         for _, _, loss in round_records:
             self._record(round_loss=loss)
+        sums = np.zeros((self.num_epoch, 3))
+        for _, e, loss in round_records:
+            sums[e] += (loss, 1.0, 0.0)
+        sums[:, 2] = dropped_per_epoch
+        if multi:
+            sums = np.asarray(
+                multihost_utils.process_allgather(sums)).sum(axis=0)
         for epoch in range(self.num_epoch):
-            losses = [l for (_, e, l) in round_records if e == epoch]
-            self._record(epoch_loss=float(np.mean(losses)),
-                         dropped_tail_batches=dropped_per_epoch[epoch])
-        self._record(staleness=list(ps.staleness_log))
-        self.parameter_server_state = ps
+            self._record(
+                epoch_loss=float(sums[epoch, 0]
+                                 / max(sums[epoch, 1], 1.0)),
+                dropped_tail_batches=int(sums[epoch, 2]))
+
+        if multi:
+            # staleness log + final center live on process 0; broadcast
+            # (two-phase: length first — shapes must match everywhere)
+            n_stal = int(np.asarray(multihost_utils.broadcast_one_to_all(
+                np.asarray([len(ps.staleness_log) if ps is not None
+                            else 0])))[0])
+            stal = np.zeros(n_stal, np.int64)
+            if rank == 0:
+                stal[:] = ps.staleness_log
+            stal = np.asarray(
+                multihost_utils.broadcast_one_to_all(stal))
+            self._record(staleness=[int(s) for s in stal])
+            final_center = multihost_utils.broadcast_one_to_all(
+                jax.tree_util.tree_map(
+                    np.asarray, ps.center if ps is not None else center),
+                is_source=rank == 0)
+        else:
+            self._record(staleness=list(ps.staleness_log))
+            final_center = ps.center
+        self.parameter_server_state = ps  # None off process 0
         self.trained_variables = {
-            "params": jax.tree_util.tree_map(jnp.asarray, ps.center),
+            "params": jax.tree_util.tree_map(jnp.asarray, final_center),
             **model_state}
         # Free-running threads have no global epoch boundary; evaluate
         # the final center once.

@@ -6,6 +6,8 @@ and prints one JSON line of results for the parent to compare."""
 import json
 import os
 
+import numpy as np
+
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
 import jax
@@ -69,6 +71,20 @@ def main():
         tp_resume_match = (resumed.history["epoch_loss"]
                            == full.history["epoch_loss"])
 
+    # Cross-host faithful PS (design 5a over real TCP): process 0
+    # hosts the server, both processes run 2 of the 4 workers; every
+    # process must report identical global telemetry and center.
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    host_ps = DOWNPOUR(cfg, fidelity="host", transport="socket",
+                       num_workers=4, communication_window=2,
+                       batch_size=8, num_epoch=1, learning_rate=0.01,
+                       worker_optimizer="adam")
+    host_ps.train(data)
+    host_center_sum = float(sum(
+        np.abs(v).sum() for v in jax.tree_util.tree_leaves(
+            host_ps.trained_variables["params"])))
+
     print(json.dumps({
         "process": jax.process_index(),
         "sync_epoch_loss": [round(x, 6)
@@ -81,6 +97,11 @@ def main():
         "tp_sync_loss": [round(x, 6)
                          for x in tp.history["epoch_loss"]],
         "tp_resume_match": tp_resume_match,
+        "host_ps_epoch_loss": [round(x, 6) for x in
+                               host_ps.history["epoch_loss"]],
+        "host_ps_commits": len(host_ps.history["staleness"][-1]),
+        "host_ps_local_rounds": len(host_ps.history["round_loss"]),
+        "host_ps_center_sum": round(host_center_sum, 6),
     }))
 
 

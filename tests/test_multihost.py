@@ -71,6 +71,16 @@ def test_two_process_cluster_trains_and_agrees(num_processes,
     # reproduced the uninterrupted run on both processes
     assert a["tp_resume_match"] is True
     assert b["tp_resume_match"] is True
+    # cross-host faithful PS (socket transport, PS on process 0):
+    # identical global telemetry and final center on both processes,
+    # every worker's commits landed, training made progress
+    assert a["host_ps_epoch_loss"] == b["host_ps_epoch_loss"]
+    assert a["host_ps_center_sum"] == b["host_ps_center_sum"]
+    assert a["host_ps_commits"] == b["host_ps_commits"]
+    # 1024 rows / 4 workers / batch 8 = 32 batches -> 16 rounds/worker
+    assert a["host_ps_commits"] == 64
+    assert a["host_ps_local_rounds"] == b["host_ps_local_rounds"] == 32
+    assert a["host_ps_epoch_loss"][-1] < 1.6  # 4-class xent from ~1.61
     # and real training signal
     sync = a["sync_epoch_loss"]
     assert sync[-1] < sync[0], sync
