@@ -1,0 +1,93 @@
+"""Fault-tolerant async training: retries, elastic workers, watchdog.
+
+The reference inherited Spark task retry — which silently replays a
+partition against the live PS (SURVEY.md §5 "semantic hazard").  This
+pipeline demonstrates the rebuilt fault story on the faithful host-PS
+arm: a chaos hook stalls one worker (caught by the liveness watchdog)
+and permanently breaks another — its first attempts consume the retry
+budget (each retry re-pulls and re-runs, at-most-once per commit),
+then it dies and is tolerated elastically while the survivors finish.
+
+Run:  python examples/elastic_training.py
+      python examples/elastic_training.py --workers 6 --kill-worker 5
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import make_parser, parse_args_and_setup, report
+
+
+def main():
+    parser = make_parser(__doc__, rows=2048, epochs=2, batch_size=16,
+                         workers=4, window=2, learning_rate=5e-3)
+    parser.add_argument("--kill-worker", type=int, default=3,
+                        help="worker id to hard-kill mid-run")
+    parser.add_argument("--stall-worker", type=int, default=1,
+                        help="worker id to stall once (transient)")
+    args = parse_args_and_setup(parser)
+    if args.checkpoint_dir or args.resume:
+        raise SystemExit(
+            "fidelity='host' (this demo's arm) cannot checkpoint "
+            "racing threads; use an emulated-fidelity example")
+    for name in ("kill_worker", "stall_worker"):
+        if not 0 <= getattr(args, name) < args.workers:
+            raise SystemExit(
+                f"--{name.replace('_', '-')} {getattr(args, name)} "
+                f"out of range for --workers {args.workers}")
+    rounds = args.rows // (args.workers * args.batch_size) // args.window
+    if rounds < 3:
+        raise SystemExit(
+            f"only {rounds} rounds/worker/epoch — need >= 3 for the "
+            f"chaos schedule (raise --rows or lower --batch-size)")
+
+    import time
+
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.evaluators import evaluate_model
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import ADAG
+
+    data = datasets.synthetic_classification(args.rows, (8,), 4,
+                                             seed=args.seed)
+    cfg = model_config("mlp", (8,), num_classes=4, hidden=(32,))
+
+    chaos = {"stalled": False, "tripped": False}
+
+    def injector(w, epoch, r):
+        if (w == args.stall_worker and epoch == 0 and r == 1
+                and not chaos["stalled"]):
+            chaos["stalled"] = True
+            print(f"[chaos] stalling worker {w} for 2s")
+            time.sleep(2.0)
+        if w == args.kill_worker and (epoch > 0 or r >= 2):
+            # permanent: every attempt fails, so the retry budget
+            # exhausts and the worker dies (tolerated elastically)
+            if not chaos["tripped"]:
+                chaos["tripped"] = True
+                print(f"[chaos] hard-killing worker {w}")
+            raise RuntimeError(f"injected hard failure on worker {w}")
+
+    t = ADAG(cfg, fidelity="host", num_workers=args.workers,
+             communication_window=args.window,
+             batch_size=args.batch_size, num_epoch=args.epochs,
+             learning_rate=args.learning_rate, worker_optimizer="adam",
+             worker_retries=2, max_worker_failures=1,
+             worker_timeout=0.5, fault_injector=injector)
+    t.train(data)
+
+    failures = t.history.get("worker_failures", [[]])[-1]
+    retries = t.history.get("worker_round_retries", [[]])[-1]
+    detected = t.history.get("detected_idle_workers", [[]])[-1]
+    print(f"[elastic] worker failures tolerated: {failures}")
+    print(f"[elastic] round retries (worker, epoch, round): {retries}")
+    print(f"[elastic] watchdog detections: {detected} "
+          "(the first entry may reflect JIT warmup, not chaos)")
+    metrics = evaluate_model(t.model, t.trained_variables, data)
+    report("elastic_training", t, metrics,
+           failures=len(failures), retries=len(retries))
+
+
+if __name__ == "__main__":
+    main()
