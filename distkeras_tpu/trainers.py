@@ -456,12 +456,6 @@ class DistributedTrainer(Trainer):
                     "arm; checkpoint/resume of racing threads is not "
                     "supported — use the emulated fidelities")
             return self._train_host(dataset, initial_variables)
-        if jax.process_count() > 1 and (self.checkpoint_dir
-                                        or resume_from):
-            raise NotImplementedError(
-                "multi-host checkpointing of sharded worker states is "
-                "not supported yet; checkpoint from a single-process "
-                "run or use SyncTrainer")
         rule = self.allocate_rule()
         tx = self._tx()
         variables = self._init_variables(initial_variables)
@@ -500,14 +494,29 @@ class DistributedTrainer(Trainer):
         ps_state = rule.init_state(center)
         perm_key = jax.random.key(self.seed + 2)
 
-        ckpt_state, cursor = self._maybe_resume(
-            resume_from, {"ps": ps_state, "workers": worker_states,
-                          "perm_key": perm_key})
-        ps_state, worker_states, perm_key = (
-            ckpt_state["ps"], ckpt_state["workers"],
-            ckpt_state["perm_key"])
-        start_epoch = int(cursor.get("epoch", 0))
-        start_round = int(cursor.get("round", 0))
+        # Multi-host: worker states are sharded across processes, so
+        # checkpoints use the per-shard orbax layout (each process
+        # writes/reads only its own rows); single-process runs keep the
+        # single-file msgpack path.  Sharded restore happens below,
+        # after mesh placement, INTO the mesh shardings.
+        from distkeras_tpu import checkpoint as ckpt_mod
+
+        self._sharded_ckpt = pc > 1
+        resume_sharded = (resume_from is not None
+                          and ckpt_mod.has_sharded(resume_from))
+        if pc > 1 and resume_from is not None and not resume_sharded:
+            raise ValueError(
+                f"multi-host resume needs a sharded checkpoint, but "
+                f"{resume_from!r} holds none — single-file msgpack "
+                f"checkpoints restore only in single-process runs")
+        cursor: dict = {}
+        if not resume_sharded:
+            ckpt_state, cursor = self._maybe_resume(
+                resume_from, {"ps": ps_state, "workers": worker_states,
+                              "perm_key": perm_key})
+            ps_state, worker_states, perm_key = (
+                ckpt_state["ps"], ckpt_state["workers"],
+                ckpt_state["perm_key"])
 
         placement = mesh_lib.place_workers(num_workers)
         if pc > 1 and (placement.mesh is None
@@ -525,6 +534,19 @@ class DistributedTrainer(Trainer):
             worker_states = mesh_lib.global_batch_from_local(
                 row, worker_states)
             ps_state = mesh_lib.global_batch_from_local(rep, ps_state)
+            if resume_sharded:
+                # the sharded layout carries the device state; the
+                # (host-local, process-identical) permutation key rides
+                # in the cursor as raw key data
+                restored, cursor = ckpt_mod.load_sharded(
+                    resume_from,
+                    {"ps": ps_state, "workers": worker_states})
+                ps_state, worker_states = (restored["ps"],
+                                           restored["workers"])
+                cursor = self._restore_history(cursor)
+                perm_key = jax.random.wrap_key_data(jnp.asarray(
+                    np.asarray(cursor.pop("perm_key_data"),
+                               np.uint32)))
             round_jit = jax.jit(
                 round_fn,
                 in_shardings=(rep, row, row, rep),
@@ -536,12 +558,30 @@ class DistributedTrainer(Trainer):
                 lambda t: jax.tree_util.tree_map(lambda x: x[0], t),
                 out_shardings=rep)
         else:
+            if resume_sharded:
+                raise ValueError(
+                    f"{resume_from!r} holds a sharded checkpoint but "
+                    f"this run has no mesh to restore it onto")
             round_jit = jax.jit(round_fn)
             slice_row0 = lambda t: jax.tree_util.tree_map(  # noqa: E731
                 lambda x: x[0], t)
 
+        start_epoch = int(cursor.get("epoch", 0))
+        start_round = int(cursor.get("round", 0))
         rows_per_worker_batch = self.batch_size
         cols = self._columns()
+
+        def save_point(point: dict):
+            # reads the loop's current ps/worker/key state at call time
+            if self._sharded_ckpt:
+                self._maybe_save(
+                    {"ps": ps_state, "workers": worker_states},
+                    {**point, "perm_key_data": np.asarray(
+                        jax.random.key_data(perm_key)).tolist()})
+            else:
+                self._maybe_save(
+                    {"ps": ps_state, "workers": worker_states,
+                     "perm_key": perm_key}, point)
 
         for epoch in range(start_epoch, self.num_epoch):
             shard_all = dataset.shuffle(seed=self.seed + 17 * epoch)
@@ -624,10 +664,7 @@ class DistributedTrainer(Trainer):
                 if every and (r + 1) % every == 0 and r + 1 < n_rounds:
                     drain(pending)
                     pending = None
-                    self._maybe_save(
-                        {"ps": ps_state, "workers": worker_states,
-                         "perm_key": perm_key},
-                        {"epoch": epoch, "round": r + 1})
+                    save_point({"epoch": epoch, "round": r + 1})
             if pending is not None:
                 drain(pending)
             self._record(epoch_loss=float(np.mean(epoch_losses)))
@@ -635,10 +672,7 @@ class DistributedTrainer(Trainer):
                 self._eval_epoch({
                     "params": ps_state.center,
                     **slice_row0(worker_states.model_state)})
-            self._maybe_save(
-                {"ps": ps_state, "workers": worker_states,
-                 "perm_key": perm_key},
-                {"epoch": epoch + 1, "round": 0})
+            save_point({"epoch": epoch + 1, "round": 0})
 
         # Keep worker 0's model state (batch stats etc.): slice on device
         # (replicated output) so only one row ever crosses to host.

@@ -71,6 +71,49 @@ def main():
         tp_resume_match = (resumed.history["epoch_loss"]
                            == full.history["epoch_loss"])
 
+    # Multi-host sharded checkpointing of the async PS family: worker
+    # states live sharded across both processes, so the checkpoint is
+    # the per-shard orbax layout; kill-at-1/2-epochs + resume must
+    # reproduce the uninterrupted run's telemetry exactly.
+    ps_resume_match = None
+    if ckpt_dir:
+        ps_dir = os.path.join(ckpt_dir, "ps_family")
+        ps_kwargs = dict(num_workers=8, communication_window=2,
+                         batch_size=8, learning_rate=0.05)
+        ps_full = ADAG(cfg, num_epoch=2, **ps_kwargs)
+        ps_full.train(data)
+
+        class _Stop(Exception):
+            pass
+
+        # crash mid-epoch-2, right after the round-2 sharded save, so
+        # the resume exercises start_round>0 + seeded history on the
+        # per-shard layout (both processes kill at the same cursor)
+        ps_part = ADAG(cfg, num_epoch=2, checkpoint_dir=ps_dir,
+                       checkpoint_every_rounds=2, **ps_kwargs)
+        orig_save = ps_part._maybe_save
+
+        def _saving(state, cursor):
+            orig_save(state, cursor)
+            if cursor.get("epoch") == 1 and cursor.get("round") == 2:
+                raise _Stop
+
+        ps_part._maybe_save = _saving
+        try:
+            ps_part.train(data)
+            raise AssertionError("kill point never reached")
+        except _Stop:
+            pass
+        ps_resumed = ADAG(cfg, num_epoch=2, **ps_kwargs)
+        ps_resumed.train(data, resume_from=ps_dir)
+        ps_resume_match = (
+            ps_resumed.history["round_loss"]
+            == ps_full.history["round_loss"]
+            and ps_resumed.history["epoch_loss"]
+            == ps_full.history["epoch_loss"]
+            and ps_resumed.history["staleness"]
+            == ps_full.history["staleness"])
+
     # Cross-host faithful PS (design 5a over real TCP): process 0
     # hosts the server, both processes run 2 of the 4 workers; every
     # process must report identical global telemetry and center.
@@ -97,6 +140,7 @@ def main():
         "tp_sync_loss": [round(x, 6)
                          for x in tp.history["epoch_loss"]],
         "tp_resume_match": tp_resume_match,
+        "ps_resume_match": ps_resume_match,
         "host_ps_epoch_loss": [round(x, 6) for x in
                                host_ps.history["epoch_loss"]],
         "host_ps_commits": len(host_ps.history["staleness"][-1]),

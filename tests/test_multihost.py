@@ -71,6 +71,9 @@ def test_two_process_cluster_trains_and_agrees(num_processes,
     # reproduced the uninterrupted run on both processes
     assert a["tp_resume_match"] is True
     assert b["tp_resume_match"] is True
+    # ...and the same for the async PS family's sharded worker states
+    assert a["ps_resume_match"] is True
+    assert b["ps_resume_match"] is True
     # cross-host faithful PS (socket transport, PS on process 0):
     # identical global telemetry and final center on both processes,
     # every worker's commits landed, training made progress
