@@ -110,6 +110,26 @@ def _norm(norm: str, dtype, train: bool) -> Callable:
     raise ValueError(f"unknown norm {norm!r}")
 
 
+def s2d_input(x: jnp.ndarray) -> jnp.ndarray:
+    """Space-to-depth, block 2: ``[N,H,W,C] -> [N,H/2,W/2,4C]`` with
+    channel layout ``(s, t, c)`` (row offset slowest)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                 4 * c)
+
+
+def s2d_stem_kernel(w7: jnp.ndarray) -> jnp.ndarray:
+    """Transform a ``[7,7,C,O]`` stride-2 stem kernel into the
+    equivalent ``[4,4,4C,O]`` stride-1 kernel over an ``s2d_input``
+    image: zero-pad to 8x8 at the front, then fold each 2x2 spatial
+    phase into the channel axis (same ``(s, t, c)`` layout)."""
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    c_in, c_out = w7.shape[2], w7.shape[3]
+    w = w8.reshape(4, 2, 4, 2, c_in, c_out)
+    return w.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c_in, c_out)
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: tuple[int, int]
@@ -169,6 +189,7 @@ class ResNet(nn.Module):
     width: int = 64
     norm: str = "group"
     dtype: str = "bfloat16"
+    stem: str = "conv"  # 'conv' | 'space_to_depth'
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -177,8 +198,25 @@ class ResNet(nn.Module):
         block = BottleneckBlock if self.bottleneck else BasicBlock
 
         x = x.astype(dtype)
-        x = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=dtype)(x)
+        if self.stem == "space_to_depth":
+            if x.shape[1] % 2 or x.shape[2] % 2:
+                raise ValueError(
+                    f"stem='space_to_depth' needs even input height/"
+                    f"width (the 2x2 phase fold), got {x.shape[1:3]}; "
+                    f"use stem='conv' for odd sizes")
+            # Exact re-layout of the 7x7/s2 stem (see s2d_stem_kernel):
+            # the 3-channel 7x7 conv half-starves the MXU's input lanes;
+            # folding the 2x2 spatial phases into channels gives an
+            # MXU-friendlier 12-channel 4x4/s1 conv with identical math.
+            x = s2d_input(x)
+            x = nn.Conv(self.width, (4, 4), padding=[(2, 1), (2, 1)],
+                        use_bias=False, dtype=dtype)(x)
+        elif self.stem == "conv":
+            x = nn.Conv(self.width, (7, 7), (2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=dtype)(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(relu=True)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, size in enumerate(self.stage_sizes):

@@ -121,3 +121,24 @@ def test_config_json_roundtrip_preserves_tuples():
     m2 = ModelSpec.from_config(wire).build()
     assert m1 == m2
     hash(m2)  # usable as a static jit argument
+
+
+def test_space_to_depth_stem_is_exact_relayout():
+    """stem='space_to_depth' with the folded kernel reproduces the
+    7x7/s2 stem to float tolerance (same math over the same receptive
+    field, MXU-friendlier layout; summation order differs)."""
+    from distkeras_tpu.models import ResNet
+    from distkeras_tpu.models.resnet import s2d_stem_kernel
+
+    kw = dict(num_classes=10, stage_sizes=(1, 1), width=8,
+              norm="group", dtype="float32")
+    conv = ResNet(stem="conv", **kw)
+    s2d = ResNet(stem="space_to_depth", **kw)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    variables = conv.init(jax.random.key(1), x)
+    params = dict(variables["params"])
+    params["Conv_0"] = {
+        "kernel": s2d_stem_kernel(params["Conv_0"]["kernel"])}
+    np.testing.assert_allclose(
+        np.asarray(s2d.apply({"params": params}, x)),
+        np.asarray(conv.apply(variables, x)), rtol=1e-5, atol=1e-5)
