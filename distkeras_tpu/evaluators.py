@@ -16,14 +16,39 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.predictors import ModelPredictor
 
 
-class AccuracyEvaluator:
-    """Classification accuracy from a prediction column.
+def _aligned_pred_labels(dataset: Dataset, prediction_col: str,
+                         label_col: str) -> tuple[np.ndarray, np.ndarray]:
+    """Class-id (pred, labels) from a scored dataset.  Predictions may
+    be class ids (int) or logits/probabilities (argmax'd); labels may be
+    integer ids, a column vector of ids (squeezed — argmaxing it would
+    zero every label), or one-hot rows (argmax'd; the reference's
+    OneHotTransformer workflow — mirrored from ops/losses.py)."""
+    pred = np.asarray(dataset[prediction_col])
+    if pred.ndim > 1:
+        # same width-1 trap as the label side: an [N, 1] column vector
+        # of class ids must be squeezed, not argmax'd to all-zeros
+        if pred.shape[-1] > 1:
+            pred = np.argmax(pred, axis=-1)
+        else:
+            pred = np.squeeze(pred, axis=-1)
+    labels = np.asarray(dataset[label_col])
+    if labels.ndim > pred.ndim:
+        # a trailing axis of width 1 is a column vector of class ids,
+        # not a one-hot encoding
+        if labels.shape[-1] > 1:
+            labels = np.argmax(labels, axis=-1)
+        else:
+            labels = np.squeeze(labels, axis=-1)
+    if labels.shape != pred.shape:
+        raise ValueError(
+            f"prediction shape {pred.shape} and label shape "
+            f"{labels.shape} do not align")
+    return pred, labels
 
-    Accepts class-id predictions (int) or logits/probabilities (argmax'd),
-    and integer or one-hot label columns (the reference's OneHotTransformer
-    workflow produces one-hot labels — mirrored from the one-hot support
-    in ops/losses.py).
-    """
+
+class AccuracyEvaluator:
+    """Classification accuracy from a prediction column (input handling
+    in ``_aligned_pred_labels``)."""
 
     def __init__(self, prediction_col: str = "prediction",
                  label_col: str = "label"):
@@ -31,22 +56,51 @@ class AccuracyEvaluator:
         self.label_col = label_col
 
     def evaluate(self, dataset: Dataset) -> float:
-        pred = np.asarray(dataset[self.prediction_col])
-        if pred.ndim > 1:
-            pred = np.argmax(pred, axis=-1)
-        labels = np.asarray(dataset[self.label_col])
-        if labels.ndim > pred.ndim:
-            # a trailing axis of width 1 is a column vector of class ids,
-            # not a one-hot encoding — argmaxing it would zero every label
-            if labels.shape[-1] > 1:
-                labels = np.argmax(labels, axis=-1)
-            else:
-                labels = np.squeeze(labels, axis=-1)
-        if labels.shape != pred.shape:
-            raise ValueError(
-                f"prediction shape {pred.shape} and label shape "
-                f"{labels.shape} do not align")
+        pred, labels = _aligned_pred_labels(
+            dataset, self.prediction_col, self.label_col)
         return float(np.mean(pred == labels))
+
+
+class ClassificationEvaluator:
+    """Multi-class precision / recall / F1 / accuracy over a scored
+    dataset — the ``pyspark.ml`` ``MulticlassClassificationEvaluator``
+    analogue the reference notebooks used (SURVEY.md §2.1 Evaluators).
+
+    ``metric``: ``'f1'`` (default, like pyspark), ``'precision'``,
+    ``'recall'``, or ``'accuracy'``; ``average`` as in
+    ``ops.metrics.precision_recall_f1``.  ``num_classes`` is inferred
+    from the data when not given.
+    """
+
+    def __init__(self, metric: str = "f1", average: str = "weighted",
+                 prediction_col: str = "prediction",
+                 label_col: str = "label",
+                 num_classes: int | None = None):
+        if metric not in ("f1", "precision", "recall", "accuracy"):
+            raise ValueError(
+                f"unknown metric {metric!r}; expected 'f1', "
+                f"'precision', 'recall', or 'accuracy'")
+        if average not in ("weighted", "macro", "micro"):
+            raise ValueError(
+                f"unknown average {average!r}; expected 'weighted', "
+                f"'macro', or 'micro'")
+        self.metric = metric
+        self.average = average
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+        self.num_classes = num_classes
+
+    def evaluate(self, dataset: Dataset) -> float:
+        from distkeras_tpu.ops.metrics import precision_recall_f1
+
+        pred, labels = _aligned_pred_labels(
+            dataset, self.prediction_col, self.label_col)
+        if self.metric == "accuracy":
+            return float(np.mean(pred == labels))
+        n = self.num_classes or int(max(pred.max(), labels.max())) + 1
+        scores = precision_recall_f1(pred, labels, num_classes=n,
+                                     average=self.average)
+        return float(scores[self.metric])
 
 
 class LossEvaluator:

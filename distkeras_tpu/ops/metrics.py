@@ -29,3 +29,67 @@ def top_k_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
     _, top = jax.lax.top_k(logits, k)
     hit = jnp.any(top == labels[..., None].astype(top.dtype), axis=-1)
     return jnp.mean(hit.astype(jnp.float32))
+
+
+def confusion_matrix(pred: jnp.ndarray, labels: jnp.ndarray,
+                     num_classes: int) -> jnp.ndarray:
+    """``[C, C]`` counts, rows = true class, cols = predicted class.
+    ``num_classes`` must be static (jit-compatible bincount).  Class
+    ids must lie in ``[0, num_classes)`` — validated on concrete
+    (non-traced) inputs; under jit the bound is the caller's contract
+    (bincount would silently drop out-of-range rows)."""
+    if not isinstance(pred, jax.core.Tracer) \
+            and not isinstance(labels, jax.core.Tracer):
+        import numpy as np
+
+        p, l = np.asarray(pred), np.asarray(labels)
+        if p.size and (p.min() < 0 or p.max() >= num_classes
+                       or l.min() < 0 or l.max() >= num_classes):
+            raise ValueError(
+                f"class ids out of range [0, {num_classes}): "
+                f"pred in [{p.min()}, {p.max()}], "
+                f"labels in [{l.min()}, {l.max()}]")
+    idx = (labels.astype(jnp.int32) * num_classes
+           + pred.astype(jnp.int32))
+    return jnp.bincount(
+        idx.reshape(-1),
+        length=num_classes * num_classes).reshape(num_classes,
+                                                  num_classes)
+
+
+def precision_recall_f1(pred: jnp.ndarray, labels: jnp.ndarray,
+                        num_classes: int, average: str = "weighted"
+                        ) -> dict[str, jnp.ndarray]:
+    """Multi-class precision / recall / F1 from class-id predictions
+    (the ``pyspark.ml`` ``MulticlassClassificationEvaluator`` surface
+    the reference notebooks leaned on — SURVEY.md §2.1 Evaluators).
+
+    ``average``: ``'weighted'`` (pyspark's default: class scores
+    weighted by true-class frequency), ``'macro'`` (unweighted class
+    mean), or ``'micro'`` (global counts; equals accuracy for
+    single-label classification).  Classes with no predictions (or no
+    true rows) score 0, the standard zero-division convention.
+    """
+    cm = confusion_matrix(pred, labels, num_classes).astype(jnp.float32)
+    tp = jnp.diagonal(cm)
+    pred_tot = cm.sum(axis=0)
+    true_tot = cm.sum(axis=1)
+    if average == "micro":
+        total = jnp.maximum(cm.sum(), 1.0)
+        p = r = tp.sum() / total
+        f1 = p
+        return {"precision": p, "recall": r, "f1": f1}
+    prec = jnp.where(pred_tot > 0, tp / jnp.maximum(pred_tot, 1.0), 0.0)
+    rec = jnp.where(true_tot > 0, tp / jnp.maximum(true_tot, 1.0), 0.0)
+    denom = prec + rec
+    f1 = jnp.where(denom > 0, 2.0 * prec * rec
+                   / jnp.maximum(denom, 1e-30), 0.0)
+    if average == "macro":
+        w = jnp.full_like(tp, 1.0 / num_classes)
+    elif average == "weighted":
+        w = true_tot / jnp.maximum(true_tot.sum(), 1.0)
+    else:
+        raise ValueError(f"unknown average {average!r}; expected "
+                         f"'weighted', 'macro', or 'micro'")
+    return {"precision": (prec * w).sum(), "recall": (rec * w).sum(),
+            "f1": (f1 * w).sum()}

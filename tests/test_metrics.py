@@ -2,6 +2,7 @@
 their wiring into evaluate_model + the in-training eval hook."""
 
 import numpy as np
+import pytest
 
 from distkeras_tpu.data import datasets
 from distkeras_tpu.evaluators import evaluate_model
@@ -66,3 +67,82 @@ def test_eval_dataset_records_accuracy_per_epoch():
              num_epoch=2, learning_rate=5e-3, worker_optimizer="adam")
     a.train(data, eval_dataset=holdout)
     assert len(a.history["eval_accuracy"]) == 2
+
+
+def test_confusion_matrix_and_prf_hand_checked():
+    from distkeras_tpu.ops.metrics import (confusion_matrix,
+                                           precision_recall_f1)
+
+    # true:  0 0 1 1 2 2 ; pred: 0 1 1 1 2 0
+    labels = np.array([0, 0, 1, 1, 2, 2])
+    pred = np.array([0, 1, 1, 1, 2, 0])
+    cm = np.asarray(confusion_matrix(pred, labels, 3))
+    np.testing.assert_array_equal(
+        cm, [[1, 1, 0], [0, 2, 0], [1, 0, 1]])
+    m = precision_recall_f1(pred, labels, 3, average="macro")
+    # per-class precision: 1/2, 2/3, 1; recall: 1/2, 1, 1/2
+    prec = (0.5 + 2 / 3 + 1.0) / 3
+    rec = (0.5 + 1.0 + 0.5) / 3
+    f1 = (0.5 + 0.8 + 2 / 3) / 3
+    np.testing.assert_allclose(float(m["precision"]), prec, rtol=1e-6)
+    np.testing.assert_allclose(float(m["recall"]), rec, rtol=1e-6)
+    np.testing.assert_allclose(float(m["f1"]), f1, rtol=1e-6)
+    # weighted: uniform class counts here => equals macro
+    w = precision_recall_f1(pred, labels, 3, average="weighted")
+    np.testing.assert_allclose(float(w["f1"]), f1, rtol=1e-6)
+    # micro == accuracy for single-label classification
+    mi = precision_recall_f1(pred, labels, 3, average="micro")
+    np.testing.assert_allclose(float(mi["f1"]), np.mean(pred == labels),
+                               rtol=1e-6)
+
+
+def test_prf_zero_division_convention():
+    from distkeras_tpu.ops.metrics import precision_recall_f1
+
+    # class 2 never predicted AND never true -> contributes 0, no nan
+    labels = np.array([0, 0, 1])
+    pred = np.array([0, 1, 1])
+    m = precision_recall_f1(pred, labels, 3, average="macro")
+    for v in m.values():
+        assert np.isfinite(float(v))
+
+
+def test_classification_evaluator_on_scored_dataset():
+    from distkeras_tpu.evaluators import ClassificationEvaluator
+    from distkeras_tpu.data.dataset import Dataset
+
+    # logits predictions + one-hot labels (the OneHot workflow)
+    logits = np.array([[2.0, 0.1, 0.0], [0.0, 3.0, 0.1],
+                       [0.1, 0.0, 1.0], [5.0, 0.0, 0.0]])
+    onehot = np.eye(3)[[0, 1, 2, 1]]
+    ds = Dataset({"prediction": logits, "label": onehot})
+    acc = ClassificationEvaluator(metric="accuracy").evaluate(ds)
+    assert acc == 0.75
+    f1 = ClassificationEvaluator(metric="f1").evaluate(ds)
+    prec = ClassificationEvaluator(metric="precision").evaluate(ds)
+    rec = ClassificationEvaluator(metric="recall").evaluate(ds)
+    assert 0 < f1 <= 1 and 0 < prec <= 1 and 0 < rec <= 1
+    # micro-averaged f1 equals accuracy
+    mi = ClassificationEvaluator(metric="f1",
+                                 average="micro").evaluate(ds)
+    np.testing.assert_allclose(mi, acc, rtol=1e-6)
+    with pytest.raises(ValueError):
+        ClassificationEvaluator(metric="auc")
+
+
+def test_prf_guards_and_column_vector_predictions():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import ClassificationEvaluator
+    from distkeras_tpu.ops.metrics import confusion_matrix
+
+    # out-of-range ids raise instead of silently dropping rows
+    with pytest.raises(ValueError, match="out of range"):
+        confusion_matrix(np.array([0, 1, 1]), np.array([0, 1, 5]), 3)
+    # [N,1] column-vector predictions are squeezed, not argmax'd
+    ds = Dataset({"prediction": np.array([[1], [2], [0]]),
+                  "label": np.array([1, 2, 0])})
+    assert ClassificationEvaluator(metric="accuracy").evaluate(ds) == 1.0
+    assert ClassificationEvaluator(metric="f1").evaluate(ds) == 1.0
+    # average typo fails at construction, not at evaluate time
+    with pytest.raises(ValueError, match="average"):
+        ClassificationEvaluator(average="marco")
