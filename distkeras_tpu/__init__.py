@@ -32,6 +32,7 @@ from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
 from distkeras_tpu.streaming import StreamingPredictor  # noqa: F401
 from distkeras_tpu.evaluators import (  # noqa: F401
     AccuracyEvaluator,
+    BinaryClassificationEvaluator,
     ClassificationEvaluator,
     LossEvaluator,
     evaluate_model,

@@ -69,7 +69,11 @@ class ClassificationEvaluator:
     ``metric``: ``'f1'`` (default, like pyspark), ``'precision'``,
     ``'recall'``, or ``'accuracy'``; ``average`` as in
     ``ops.metrics.precision_recall_f1``.  ``num_classes`` is inferred
-    from the data when not given.
+    from the data (max id + 1) when not given — except for
+    ``average='macro'``, whose denominator is the class count itself:
+    there an explicit ``num_classes`` is required, otherwise the score
+    would silently depend on which classes happen to appear in the
+    evaluated split.
     """
 
     def __init__(self, metric: str = "f1", average: str = "weighted",
@@ -84,6 +88,13 @@ class ClassificationEvaluator:
             raise ValueError(
                 f"unknown average {average!r}; expected 'weighted', "
                 f"'macro', or 'micro'")
+        if average == "macro" and num_classes is None \
+                and metric != "accuracy":
+            raise ValueError(
+                "average='macro' needs an explicit num_classes (its "
+                "denominator is the class count; inferring it from "
+                "the evaluated split would make the score depend on "
+                "which classes happen to appear)")
         self.metric = metric
         self.average = average
         self.prediction_col = prediction_col
@@ -95,12 +106,59 @@ class ClassificationEvaluator:
 
         pred, labels = _aligned_pred_labels(
             dataset, self.prediction_col, self.label_col)
+        if pred.size == 0:
+            raise ValueError("cannot evaluate an empty dataset")
         if self.metric == "accuracy":
             return float(np.mean(pred == labels))
         n = self.num_classes or int(max(pred.max(), labels.max())) + 1
         scores = precision_recall_f1(pred, labels, num_classes=n,
                                      average=self.average)
         return float(scores[self.metric])
+
+
+class BinaryClassificationEvaluator:
+    """AUC-ROC (default) or accuracy over a scored dataset with a
+    single score per row — the ``pyspark.ml``
+    ``BinaryClassificationEvaluator`` analogue for the Criteo-style
+    binary configs.  The prediction column may be ``[N]`` or ``[N, 1]``
+    logits/probabilities (any monotone ranking gives the same AUC);
+    labels in {0, 1}."""
+
+    def __init__(self, metric: str = "auc",
+                 prediction_col: str = "prediction",
+                 label_col: str = "label", threshold: float = 0.0):
+        """``threshold`` only affects ``metric='accuracy'``: scores
+        above it classify as 1 (0.0 suits logits; use 0.5 for
+        probabilities).  AUC is threshold-free."""
+        if metric not in ("auc", "accuracy"):
+            raise ValueError(f"unknown metric {metric!r}; expected "
+                             f"'auc' or 'accuracy'")
+        self.metric = metric
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+        self.threshold = float(threshold)
+
+    def evaluate(self, dataset: Dataset) -> float:
+        from distkeras_tpu.ops.metrics import auc_roc, binary_accuracy
+
+        scores = np.asarray(dataset[self.prediction_col])
+        if scores.ndim > 1:
+            if scores.shape[-1] != 1:
+                raise ValueError(
+                    f"binary evaluation needs one score per row, got "
+                    f"shape {scores.shape}")
+            scores = np.squeeze(scores, axis=-1)
+        labels = np.asarray(dataset[self.label_col]).reshape(-1)
+        if scores.shape != labels.shape:
+            raise ValueError(
+                f"score shape {scores.shape} and label shape "
+                f"{labels.shape} do not align")
+        if scores.size == 0:
+            raise ValueError("cannot evaluate an empty dataset")
+        if self.metric == "accuracy":
+            return float(binary_accuracy(scores - self.threshold,
+                                         labels))
+        return float(auc_roc(scores, labels))
 
 
 class LossEvaluator:

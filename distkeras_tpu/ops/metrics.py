@@ -31,6 +31,43 @@ def top_k_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
     return jnp.mean(hit.astype(jnp.float32))
 
 
+def auc_roc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Area under the ROC curve via the Mann-Whitney U statistic
+    (rank-based, tie-aware) — the ``pyspark.ml``
+    ``BinaryClassificationEvaluator('areaUnderROC')`` surface for the
+    Criteo-style binary configs.  ``scores`` are any monotone ranking
+    (logits or probabilities); ``labels`` in {0, 1}.  Under jit a
+    single-class batch yields NaN; on concrete inputs, bad labels or a
+    single-class input raise a clear error instead."""
+    scores = scores.reshape(-1).astype(jnp.float32)
+    labels = labels.reshape(-1).astype(jnp.float32)
+    if not isinstance(labels, jax.core.Tracer):
+        import numpy as np
+
+        l = np.asarray(labels)
+        if l.size and not np.isin(l, (0.0, 1.0)).all():
+            raise ValueError(
+                f"auc_roc needs labels in {{0, 1}}, got values in "
+                f"[{l.min()}, {l.max()}]")
+        if l.size and (l.min() == l.max()):
+            raise ValueError(
+                "auc_roc needs both classes present, got only "
+                f"label {l.min()}")
+    sorted_scores = jnp.sort(scores)
+    # tie-aware average rank (1-based): mean of the left/right insertion
+    # positions among the sorted scores
+    lo = jnp.searchsorted(sorted_scores, scores, side="left")
+    hi = jnp.searchsorted(sorted_scores, scores, side="right")
+    ranks = (lo + hi + 1.0) / 2.0
+    pos = labels.sum()
+    neg = labels.shape[0] - pos
+    u = (ranks * labels).sum() - pos * (pos + 1.0) / 2.0
+    # single-class input (reachable only under jit, where the concrete
+    # check is skipped) is NaN, not a fake 0.0
+    return jnp.where(pos * neg > 0,
+                     u / jnp.maximum(pos * neg, 1e-30), jnp.nan)
+
+
 def confusion_matrix(pred: jnp.ndarray, labels: jnp.ndarray,
                      num_classes: int) -> jnp.ndarray:
     """``[C, C]`` counts, rows = true class, cols = predicted class.

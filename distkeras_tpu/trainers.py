@@ -86,10 +86,13 @@ class Trainer:
     trained result and timing."""
 
     def __init__(self, model, loss: str = "categorical_crossentropy",
-                 worker_optimizer="sgd", learning_rate: float | None = None,
+                 worker_optimizer="sgd", learning_rate=None,
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
                  checkpoint_dir: str | None = None):
+        """``learning_rate``: float, optax schedule, or a JSON-friendly
+        ``{"schedule": name, **kwargs}`` dict (see
+        ``workers.resolve_schedule``)."""
         self.spec = _resolve_spec(model)
         self.model = self.spec.build()
         self.loss = loss
@@ -1089,7 +1092,15 @@ class AEASGD(DistributedTrainer):
 
     @property
     def alpha(self) -> float:
-        return float(self.learning_rate) * self.rho
+        try:
+            lr = float(self.learning_rate)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "the elastic family derives alpha = learning_rate * "
+                "rho (the paper's stability condition), which needs a "
+                "scalar learning_rate — schedules are not supported "
+                f"here, got {self.learning_rate!r}") from None
+        return lr * self.rho
 
     def allocate_rule(self):
         return ElasticRule(alpha=self.alpha)

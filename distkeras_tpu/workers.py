@@ -41,9 +41,49 @@ OPTIMIZERS: dict[str, Callable[..., optax.GradientTransformation]] = {
 }
 
 
-def resolve_optimizer(optimizer, learning_rate: float | None = None,
+SCHEDULES: dict[str, Callable[..., Any]] = {
+    "constant": lambda value: optax.constant_schedule(value),
+    "cosine": optax.cosine_decay_schedule,
+    "exponential": optax.exponential_decay,
+    "warmup_cosine": optax.warmup_cosine_decay_schedule,
+    "piecewise_constant": lambda init_value, boundaries_and_scales:
+        optax.piecewise_constant_schedule(
+            init_value, {int(k): float(v)
+                         for k, v in boundaries_and_scales.items()}),
+}
+
+
+def resolve_schedule(spec):
+    """Learning-rate spec -> something optax accepts as a rate.
+
+    ``spec`` may be a float (constant), a callable (an optax schedule,
+    passed through), or a JSON-friendly dict
+    ``{"schedule": <name>, **kwargs}`` with optax's own kwarg names —
+    e.g. ``{"schedule": "cosine", "init_value": 0.1,
+    "decay_steps": 1000}``.  Schedules advance with the optimizer's
+    update count: per-worker local steps under the PS trainers, global
+    steps under Single/Sync.
+    """
+    import numbers
+
+    if spec is None or isinstance(spec, numbers.Real) or callable(spec):
+        return spec  # numbers.Real covers numpy scalar types too
+    if hasattr(spec, "dtype") and getattr(spec, "ndim", None) == 0:
+        return spec  # 0-d array scalar — optax takes it directly
+    if isinstance(spec, Mapping):
+        kwargs = dict(spec)
+        name = kwargs.pop("schedule", None)
+        if name not in SCHEDULES:
+            raise KeyError(f"unknown schedule {name!r}; known: "
+                           f"{sorted(SCHEDULES)}")
+        return SCHEDULES[name](**kwargs)
+    raise TypeError(f"cannot resolve a learning rate from {type(spec)}")
+
+
+def resolve_optimizer(optimizer, learning_rate=None,
                       **kwargs) -> optax.GradientTransformation:
-    """String name / optax transform -> optax transform."""
+    """String name / optax transform -> optax transform.
+    ``learning_rate`` accepts anything ``resolve_schedule`` does."""
     if isinstance(optimizer, optax.GradientTransformation):
         return optimizer
     if isinstance(optimizer, str):
@@ -51,7 +91,7 @@ def resolve_optimizer(optimizer, learning_rate: float | None = None,
             raise KeyError(f"unknown optimizer {optimizer!r}; known: "
                            f"{sorted(OPTIMIZERS)}")
         if learning_rate is not None:
-            kwargs["lr"] = learning_rate
+            kwargs["lr"] = resolve_schedule(learning_rate)
         return OPTIMIZERS[optimizer](**kwargs)
     raise TypeError(f"cannot resolve optimizer from {type(optimizer)}")
 

@@ -146,3 +146,95 @@ def test_prf_guards_and_column_vector_predictions():
     # average typo fails at construction, not at evaluate time
     with pytest.raises(ValueError, match="average"):
         ClassificationEvaluator(average="marco")
+
+
+def _auc_pairwise(scores, labels):
+    """O(n^2) reference: P(score_pos > score_neg) + 0.5 P(tie)."""
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+def test_auc_roc_matches_pairwise_reference():
+    from distkeras_tpu.ops.metrics import auc_roc
+
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=200)
+    labels = (rng.uniform(size=200) < 0.3).astype(np.int32)
+    np.testing.assert_allclose(float(auc_roc(scores, labels)),
+                               _auc_pairwise(scores, labels), rtol=1e-6)
+    # ties (quantized scores) use average ranks
+    q = np.round(scores * 2) / 2
+    np.testing.assert_allclose(float(auc_roc(q, labels)),
+                               _auc_pairwise(q, labels), rtol=1e-6)
+    # perfect / inverted / random-identical sanity
+    s = np.array([0.1, 0.2, 0.8, 0.9])
+    l = np.array([0, 0, 1, 1])
+    assert float(auc_roc(s, l)) == 1.0
+    assert float(auc_roc(-s, l)) == 0.0
+    with pytest.raises(ValueError, match="both classes"):
+        auc_roc(s, np.ones(4))
+
+
+def test_binary_classification_evaluator():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import BinaryClassificationEvaluator
+
+    logits = np.array([[-2.0], [-0.5], [0.7], [1.5]])
+    labels = np.array([0, 1, 0, 1])
+    ds = Dataset({"prediction": logits, "label": labels})
+    auc = BinaryClassificationEvaluator().evaluate(ds)
+    np.testing.assert_allclose(
+        auc, _auc_pairwise(logits.reshape(-1), labels), rtol=1e-6)
+    acc = BinaryClassificationEvaluator(metric="accuracy").evaluate(ds)
+    assert acc == 0.5  # thresh 0: pred = [0,0,1,1] vs [0,1,0,1]
+    # probability scores with threshold 0.5
+    probs = 1 / (1 + np.exp(-logits))
+    ds2 = Dataset({"prediction": probs, "label": labels})
+    np.testing.assert_allclose(
+        BinaryClassificationEvaluator().evaluate(ds2), auc, rtol=1e-6)
+    acc2 = BinaryClassificationEvaluator(
+        metric="accuracy", threshold=0.5).evaluate(ds2)
+    assert acc2 == acc
+    with pytest.raises(ValueError, match="one score per row"):
+        BinaryClassificationEvaluator().evaluate(
+            Dataset({"prediction": np.zeros((4, 2)), "label": labels}))
+
+
+def test_auc_and_macro_guards():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import ClassificationEvaluator
+    from distkeras_tpu.ops.metrics import auc_roc
+    import jax
+
+    # non-{0,1} labels raise on concrete inputs...
+    with pytest.raises(ValueError, match="labels in"):
+        auc_roc(np.array([0.1, 0.2]), np.array([1, 2]))
+    # ...and a single-class batch under jit is NaN, not 0.0
+    out = jax.jit(auc_roc)(np.array([0.1, 0.2, 0.3]), np.ones(3))
+    assert np.isnan(float(out))
+    # macro averaging without an explicit class count fails fast
+    with pytest.raises(ValueError, match="explicit num_classes"):
+        ClassificationEvaluator(metric="f1", average="macro")
+    ev = ClassificationEvaluator(metric="f1", average="macro",
+                                 num_classes=4)
+    ds = Dataset({"prediction": np.array([0, 1]),
+                  "label": np.array([0, 1])})
+    # 2 perfect classes out of 4 -> macro f1 = 0.5
+    np.testing.assert_allclose(ev.evaluate(ds), 0.5, rtol=1e-6)
+    with pytest.raises(ValueError, match="empty"):
+        ClassificationEvaluator(metric="f1").evaluate(
+            Dataset({"prediction": np.zeros((0,)),
+                     "label": np.zeros((0,))}))
+
+
+def test_binary_evaluator_rejects_empty():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import BinaryClassificationEvaluator
+
+    with pytest.raises(ValueError, match="empty"):
+        BinaryClassificationEvaluator().evaluate(
+            Dataset({"prediction": np.zeros((0,)),
+                     "label": np.zeros((0,))}))

@@ -177,3 +177,45 @@ def test_member_parallel_ensemble_on_mesh():
     la = jax.tree_util.tree_leaves(models[0]["params"])
     lb = jax.tree_util.tree_leaves(models[7]["params"])
     assert any(not np.allclose(x, y) for x, y in zip(la, lb))
+
+
+def test_learning_rate_schedules():
+    from distkeras_tpu.workers import resolve_schedule
+
+    sched = resolve_schedule({"schedule": "cosine", "init_value": 0.1,
+                              "decay_steps": 10})
+    assert abs(float(sched(0)) - 0.1) < 1e-7
+    assert float(sched(10)) < 1e-7
+    with pytest.raises(KeyError):
+        resolve_schedule({"schedule": "nope"})
+
+    # end-to-end: a dict schedule through a trainer converges
+    data = datasets.synthetic_classification(512, (8,), 4, seed=0)
+    cfg = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    t = SingleTrainer(cfg, worker_optimizer="momentum", batch_size=32,
+                      num_epoch=3,
+                      learning_rate={"schedule": "warmup_cosine",
+                                     "init_value": 0.0,
+                                     "peak_value": 0.1,
+                                     "warmup_steps": 8,
+                                     "decay_steps": 48})
+    t.train(data)
+    losses = t.history["epoch_loss"]
+    assert losses[-1] < losses[0], losses
+
+    # the elastic family needs a scalar lr for alpha = lr * rho
+    with pytest.raises(ValueError, match="scalar learning_rate"):
+        AEASGD(cfg, num_workers=2,
+               learning_rate={"schedule": "cosine", "init_value": 0.1,
+                              "decay_steps": 10}).allocate_rule()
+
+
+def test_numpy_scalar_learning_rate_passes_through():
+    from distkeras_tpu.workers import resolve_optimizer, resolve_schedule
+    import jax.numpy as jnp
+
+    assert resolve_schedule(np.float32(1e-3)) == np.float32(1e-3)
+    resolve_optimizer("adam", np.float32(1e-3))
+    resolve_optimizer("sgd", jnp.asarray(1e-2))  # 0-d array scalar
+    t = AEASGD(MLP, num_workers=2, learning_rate=np.float32(0.01))
+    assert abs(t.alpha - 0.05) < 1e-7  # rho=5.0 default
