@@ -169,6 +169,10 @@ class PSServer:
 
     def __init__(self, ps: HostParameterServer, template: Pytree,
                  host: str = "127.0.0.1", port: int = 0):
+        """The handshake frame is ``4-byte worker id`` optionally
+        followed by a codec name (``parallel.compression``): commits on
+        that connection then arrive codec-encoded instead of as raw
+        msgpack params — the wire-compression arm."""
         self.ps = ps
         self._template = _to_numpy(template)
         self._sock = socket.socket()
@@ -213,8 +217,14 @@ class PSServer:
     def _serve(self, conn: socket.socket):
         with conn:
             try:
-                worker_id = int.from_bytes(transport.recv_msg(conn),
-                                           "big")
+                hello = transport.recv_msg(conn)
+                worker_id = int.from_bytes(hello[:4], "big")
+                codec = None
+                if len(hello) > 4:
+                    from distkeras_tpu.parallel.compression import (
+                        resolve_codec)
+
+                    codec = resolve_codec(hello[4:].decode())
                 while True:
                     msg = transport.recv_msg(conn)
                     cmd, body = msg[:1], msg[1:]
@@ -225,8 +235,12 @@ class PSServer:
                         seq = int.from_bytes(body[:8], "big")
                         if seq == _NO_SEQ:
                             seq = None
-                        payload = deserialize_params(self._template,
-                                                     body[8:])
+                        if codec is not None:
+                            payload = codec.decode(body[8:],
+                                                   self._template)
+                        else:
+                            payload = deserialize_params(
+                                self._template, body[8:])
                         local = None
                         if self.ps.rule.pull_uses_local:
                             local = deserialize_params(
@@ -247,6 +261,16 @@ class PSServer:
                         raise ValueError(f"unknown command {cmd!r}")
             except (ConnectionError, OSError):
                 return  # client gone; reference handlers did the same
+            except Exception as e:
+                # malformed frame / decode failure: drop the connection
+                # with a diagnostic instead of dying silently (the
+                # client sees a ConnectionError and retries/fails)
+                import sys
+
+                print(f"[distkeras_tpu] PS handler error (worker "
+                      f"connection dropped): {e!r}", file=sys.stderr,
+                      flush=True)
+                return
 
     def stop(self):
         self._stop.set()
@@ -269,10 +293,20 @@ class PSClient:
     as the reference opened one socket per Spark task)."""
 
     def __init__(self, host: str, port: int, worker_id: int,
-                 template: Pytree):
+                 template: Pytree, codec=None):
+        """``codec`` (a ``parallel.compression`` codec or name): commits
+        are sent codec-encoded — pass pre-encoded ``bytes`` to
+        ``commit`` (the worker loop encodes once and keeps the residual
+        for error feedback)."""
+        from distkeras_tpu.parallel.compression import resolve_codec
+
         self._sock = transport.connect(host, port, timeout=30.0)
         self._template = _to_numpy(template)
-        transport.send_msg(self._sock, int(worker_id).to_bytes(4, "big"))
+        self.codec = resolve_codec(codec)
+        hello = int(worker_id).to_bytes(4, "big")
+        if self.codec is not None:
+            hello += self.codec.name.encode()
+        transport.send_msg(self._sock, hello)
 
     def pull(self) -> Pytree:
         transport.send_msg(self._sock, b"p")
@@ -288,9 +322,22 @@ class PSClient:
         if seq is not None and not 0 <= wire_seq < _NO_SEQ:
             raise ValueError(
                 f"seq out of range [0, 2**64-1): {seq}")
+        if isinstance(payload, bytes):
+            if self.codec is None:
+                raise ValueError(
+                    "pre-encoded commit bytes need a codec declared at "
+                    "connect time (PSClient(codec=...))")
+            body = payload
+        elif self.codec is not None:
+            # codec connection, tree payload: encode here (the server
+            # decodes everything on this connection with the codec) —
+            # callers wanting error feedback encode themselves and pass
+            # bytes, keeping the residual
+            body = self.codec.encode(payload)
+        else:
+            body = serialize_params(_to_numpy(payload))
         transport.send_msg(self._sock,
-                           b"c" + wire_seq.to_bytes(8, "big"),
-                           serialize_params(_to_numpy(payload)))
+                           b"c" + wire_seq.to_bytes(8, "big"), body)
         if local is not None:
             transport.send_msg(self._sock,
                                serialize_params(_to_numpy(local)))

@@ -404,7 +404,7 @@ class DistributedTrainer(Trainer):
                  max_worker_failures: int = 0,
                  worker_retries: int = 0,
                  worker_timeout: float | None = None,
-                 fault_injector=None, **kwargs):
+                 fault_injector=None, compression=None, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -423,7 +423,12 @@ class DistributedTrainer(Trainer):
         arms a watchdog that records workers silent on the PS heartbeat
         beyond the timeout into ``history['detected_idle_workers']`` —
         the detection signal; the retry/elastic machinery is the
-        action."""
+        action.  ``compression`` (``'int8'`` / ``'bfloat16'`` /
+        ``'topk[:frac]'`` / a ``parallel.compression`` codec, host arm
+        only) compresses each delta-family commit on the wire with
+        client-side error feedback; wire/raw byte totals land in
+        ``history['commit_wire_bytes']`` / ``['commit_raw_bytes']``
+        (process-local under multi-host)."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -435,18 +440,21 @@ class DistributedTrainer(Trainer):
         self.fault_injector = fault_injector
         self.worker_timeout = (None if worker_timeout is None
                                else float(worker_timeout))
+        self.compression = compression
         if self.worker_timeout is not None and self.worker_timeout <= 0:
             raise ValueError(
                 f"worker_timeout must be positive, got {worker_timeout}")
         if fidelity != "host" and (self.max_worker_failures
                                    or self.worker_retries
                                    or self.worker_timeout is not None
-                                   or fault_injector is not None):
+                                   or fault_injector is not None
+                                   or compression is not None):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
-                "/ fault_injector apply only to fidelity='host' (the "
-                "emulated arms are deterministic; recover via "
-                f"checkpoint/resume), got fidelity={fidelity!r}")
+                "/ fault_injector / compression apply only to "
+                "fidelity='host' (the emulated arms are deterministic; "
+                "recover via checkpoint/resume), got "
+                f"fidelity={fidelity!r}")
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
@@ -701,11 +709,21 @@ class DistributedTrainer(Trainer):
         reduced so every process returns identical results."""
         import threading
 
+        from distkeras_tpu.parallel.compression import (raw_nbytes,
+                                                        resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
             HostParameterServer, PSClient, PSServer)
-        from distkeras_tpu.utils import tree_sub
+        from distkeras_tpu.utils import (tree_add, tree_sub,
+                                         tree_zeros_like)
 
         rule = self.allocate_rule()
+        codec = resolve_codec(self.compression)
+        if codec is not None and rule.payload_kind != "delta":
+            raise ValueError(
+                "compression applies only to the delta-family rules "
+                "(DOWNPOUR/ADAG/DynSGD): their additive payloads are "
+                "error-feedback-correctable; the elastic family "
+                "commits absolute parameters")
         tx = self._tx()
         variables = self._init_variables(initial_variables)
         center = variables["params"]
@@ -779,6 +797,7 @@ class DistributedTrainer(Trainer):
         round_records: list[tuple[int, int, float]] = []
         retry_records: list[tuple[int, int, int]] = []
         failures: list[tuple[int, BaseException]] = []
+        byte_totals = [0, 0]  # [wire, raw] commit bytes (codec arm)
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -831,18 +850,21 @@ class DistributedTrainer(Trainer):
                 nonlocal client
                 if ps_address is not None:
                     client = PSClient(*ps_address, worker_id=w,
-                                      template=center)
+                                      template=center, codec=codec)
                     return client.pull, client.commit
                 # In-process commits are atomic (apply-and-return under
                 # the lock — no lost-ack window), so no dedupe seq.
                 return (lambda: ps.pull(w),
                         lambda p, l=None, seq=None: ps.commit(w, p, l))
 
+            wire_bytes = raw_bytes = 0
             try:
                 commit_seq = 0
                 state = TrainState.create(
                     {"params": center, **model_state}, tx,
                     worker_keys[w])
+                residual = (tree_zeros_like(center)
+                            if codec is not None else None)
                 attempts = 0
                 while True:  # startup contact, same retry budget
                     try:
@@ -880,6 +902,7 @@ class DistributedTrainer(Trainer):
                             for k, v in stacked.items()}
                         attempts = 0
                         reconnect = False
+                        pending_commit = None  # (bytes, applied, total)
                         base_state = state  # pre-round snapshot: a
                         # retried window must not see optimizer
                         # moments / rng / step already advanced by the
@@ -911,10 +934,40 @@ class DistributedTrainer(Trainer):
                                         tree_sub(state.params,
                                                  start_params), window)
                                     local = None
-                                pulled = commit(
-                                    payload,
-                                    local if rule.pull_uses_local
-                                    else None, seq=commit_seq)
+                                if codec is not None:
+                                    # Error feedback: fold the residual
+                                    # under-transmitted so far into this
+                                    # window's delta.  The encoding is
+                                    # cached per commit_seq: a retry
+                                    # whose first attempt died AFTER
+                                    # encoding resends the identical
+                                    # bytes (the server may have applied
+                                    # them and just lost the ack — seq
+                                    # dedupe returns the cached reply),
+                                    # so the residual is always computed
+                                    # against what the server actually
+                                    # absorbed.
+                                    if pending_commit is None:
+                                        total = tree_add(payload,
+                                                         residual)
+                                        pending_commit = (
+                                            *codec.round_trip(total),
+                                            total)
+                                    encoded, applied, total = (
+                                        pending_commit)
+                                    pulled = commit(
+                                        encoded if client is not None
+                                        else applied,
+                                        None, seq=commit_seq)
+                                    residual = tree_sub(total, applied)
+                                    pending_commit = None
+                                    wire_bytes += len(encoded)
+                                    raw_bytes += raw_nbytes(payload)
+                                else:
+                                    pulled = commit(
+                                        payload,
+                                        local if rule.pull_uses_local
+                                        else None, seq=commit_seq)
                                 commit_seq += 1
                                 break
                             except Exception:
@@ -944,6 +997,13 @@ class DistributedTrainer(Trainer):
             except BaseException as e:  # handled by the join below
                 note_death(w)
                 failures.append((w, e))
+            finally:
+                # telemetry flush runs even for workers that die
+                # mid-run — their applied commits' traffic was real
+                if codec is not None:
+                    with history_lock:
+                        byte_totals[0] += wire_bytes
+                        byte_totals[1] += raw_bytes
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in local_workers]
@@ -1010,6 +1070,9 @@ class DistributedTrainer(Trainer):
                                           for w, e in failures])
         if retry_records:
             self._record(worker_round_retries=list(retry_records))
+        if codec is not None:
+            self._record(commit_wire_bytes=byte_totals[0],
+                         commit_raw_bytes=byte_totals[1])
 
         # round_loss is per-process telemetry (this process's workers);
         # epoch_loss / dropped tails are reduced globally so every
