@@ -17,7 +17,8 @@ takes a live model.
 
 Supported layers: InputLayer, Dense, Activation, Dropout, Flatten,
 Conv2D, MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D,
-Embedding, BatchNormalization.  Anything else raises with the layer
+Embedding, BatchNormalization, LSTM, Bidirectional(LSTM) — the
+reference's IMDB workflow shape.  Anything else raises with the layer
 name so the gap is visible, not silent.
 """
 
@@ -107,9 +108,30 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
     if class_name == "GlobalAveragePooling2D":
         return {"kind": "global_avg_pool"}
     if class_name == "Embedding":
+        if cfg.get("mask_zero"):
+            raise NotImplementedError(
+                "Embedding(mask_zero=True) is not supported: keras "
+                "propagates the mask into recurrent layers, which the "
+                "ingested model would silently ignore on padded "
+                "sequences — rebuild natively (models.BiLSTMClassifier "
+                "masks pads) or re-export without mask_zero")
         return {"kind": "embedding",
                 "input_dim": int(cfg["input_dim"]),
                 "output_dim": int(cfg["output_dim"])}
+    if class_name == "LSTM":
+        return _normalize_lstm(cfg, kind="lstm")
+    if class_name == "Bidirectional":
+        inner = cfg.get("layer", {})
+        if inner.get("class_name") != "LSTM":
+            raise NotImplementedError(
+                f"Bidirectional({inner.get('class_name')!r}) is not "
+                f"supported; only Bidirectional(LSTM)")
+        if cfg.get("merge_mode", "concat") != "concat":
+            raise NotImplementedError(
+                f"Bidirectional merge_mode="
+                f"{cfg.get('merge_mode')!r} is not supported; only "
+                f"'concat'")
+        return _normalize_lstm(inner.get("config", {}), kind="bilstm")
     if class_name == "BatchNormalization":
         if not (cfg.get("center", True) and cfg.get("scale", True)):
             raise NotImplementedError(
@@ -128,9 +150,36 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
                 "momentum": float(cfg.get("momentum", 0.99))}
     raise NotImplementedError(
         f"keras layer {class_name!r} is not supported by the "
-        f"ingestion shim (Dense/Conv2D/pooling/Embedding/BatchNorm "
-        f"stacks are); rebuild this model natively with "
-        f"distkeras_tpu.models instead")
+        f"ingestion shim (Dense/Conv2D/pooling/Embedding/BatchNorm/"
+        f"LSTM/Bidirectional(LSTM) stacks are); rebuild this model "
+        f"natively with distkeras_tpu.models instead")
+
+
+def _normalize_lstm(cfg: Mapping[str, Any], kind: str) -> dict:
+    """LSTM config checks: only the (modern keras default) gate
+    functions match flax's LSTMCell equations exactly."""
+    if cfg.get("activation", "tanh") != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise NotImplementedError(
+            f"LSTM with activation={cfg.get('activation')!r} / "
+            f"recurrent_activation={cfg.get('recurrent_activation')!r} "
+            f"is not supported; only tanh/sigmoid (note: keras<2.3 "
+            f"defaulted recurrent_activation to 'hard_sigmoid')")
+    if not cfg.get("use_bias", True):
+        raise NotImplementedError("LSTM(use_bias=False) not supported")
+    if cfg.get("go_backwards"):
+        raise NotImplementedError(
+            "LSTM(go_backwards=True) not supported (use Bidirectional)")
+    if cfg.get("dropout") or cfg.get("recurrent_dropout"):
+        raise NotImplementedError(
+            "LSTM dropout/recurrent_dropout are not supported — "
+            "silently dropping them would change training behavior; "
+            "re-export without them or add a standalone Dropout layer")
+    if cfg.get("stateful"):
+        raise NotImplementedError("stateful LSTM is not supported")
+    return {"kind": kind, "units": int(cfg["units"]),
+            "return_sequences": bool(cfg.get("return_sequences",
+                                             False))}
 
 
 def _infer_input_shape(arch: Mapping[str, Any]) -> tuple[int, ...] | None:
@@ -230,9 +279,53 @@ class KerasSequential(nn.Module):
                                  epsilon=layer["epsilon"],
                                  momentum=layer["momentum"],
                                  dtype=dtype, name=name)(x)
+            elif kind == "lstm":
+                # the RNN wrapper owns no params; naming the CELL is
+                # what pins the weight-mapping path
+                y = nn.RNN(nn.OptimizedLSTMCell(layer["units"],
+                                                dtype=dtype,
+                                                name=name))(x)
+                x = y if layer["return_sequences"] else y[:, -1]
+            elif kind == "bilstm":
+                # keras Bidirectional(LSTM, merge_mode='concat'):
+                # backward outputs are time-aligned (keep_order); its
+                # "last" output is the one at original index 0
+                yf = nn.RNN(nn.OptimizedLSTMCell(
+                    layer["units"], dtype=dtype, name=name + "_fwd"))(x)
+                yb = nn.RNN(nn.OptimizedLSTMCell(
+                    layer["units"], dtype=dtype, name=name + "_bwd"),
+                    reverse=True, keep_order=True)(x)
+                if layer["return_sequences"]:
+                    x = jnp.concatenate([yf, yb], axis=-1)
+                else:
+                    x = jnp.concatenate([yf[:, -1], yb[:, 0]], axis=-1)
             else:  # unreachable: _normalize_layer gates kinds
                 raise AssertionError(kind)
         return x
+
+
+def _lstm_cell_params(W: np.ndarray, U: np.ndarray,
+                      b: np.ndarray) -> dict:
+    """Keras fused LSTM arrays -> flax ``OptimizedLSTMCell`` params.
+
+    Keras packs the four gates along the last axis in order i, f, g(c),
+    o — the same equations flax's cell computes with per-gate denses:
+    input kernels ``ii/if/ig/io`` (no bias) and hidden kernels
+    ``hi/hf/hg/ho`` (carrying the single keras bias)."""
+    u = U.shape[0]
+    if W.shape[1] != 4 * u or b.shape[0] != 4 * u:
+        raise ValueError(
+            f"LSTM weight shapes do not agree: kernel {W.shape}, "
+            f"recurrent {U.shape}, bias {b.shape}")
+    Wi, Wf, Wg, Wo = (W[:, j * u:(j + 1) * u] for j in range(4))
+    Ui, Uf, Ug, Uo = (U[:, j * u:(j + 1) * u] for j in range(4))
+    bi, bf, bg, bo = (b[j * u:(j + 1) * u] for j in range(4))
+    return {"ii": {"kernel": Wi}, "if": {"kernel": Wf},
+            "ig": {"kernel": Wg}, "io": {"kernel": Wo},
+            "hi": {"kernel": Ui, "bias": bi},
+            "hf": {"kernel": Uf, "bias": bf},
+            "hg": {"kernel": Ug, "bias": bg},
+            "ho": {"kernel": Uo, "bias": bo}}
 
 
 def _map_weights(layers: Sequence[Mapping[str, Any]],
@@ -242,7 +335,9 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
     Keras lists each layer's arrays in creation order: Dense/Conv
     ``[kernel, bias]`` (kernels already HWIO / in-out, matching flax),
     Embedding ``[table]``, BatchNorm ``[gamma, beta, moving_mean,
-    moving_var]``."""
+    moving_var]``, LSTM ``[kernel (in, 4u), recurrent (u, 4u),
+    bias (4u)]`` with gate order i, f, g(c), o (Bidirectional: forward
+    triple then backward triple)."""
     weights = [np.asarray(w) for w in weights]
     params: dict[str, Any] = {}
     batch_stats: dict[str, Any] = {}
@@ -270,6 +365,13 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
         elif kind == "batchnorm":
             params[name] = {"scale": take(), "bias": take()}
             batch_stats[name] = {"mean": take(), "var": take()}
+        elif kind == "lstm":
+            params[name] = _lstm_cell_params(take(), take(), take())
+        elif kind == "bilstm":
+            params[name + "_fwd"] = _lstm_cell_params(
+                take(), take(), take())
+            params[name + "_bwd"] = _lstm_cell_params(
+                take(), take(), take())
     if pos != len(weights):
         raise ValueError(
             f"keras weight list has {len(weights)} arrays but the "

@@ -305,6 +305,22 @@ class PSClient:
         self.codec = resolve_codec(codec)
         hello = int(worker_id).to_bytes(4, "big")
         if self.codec is not None:
+            # The wire carries only the codec NAME; the server decodes
+            # with its own name-resolved instance.  A custom codec class
+            # (or a subclass shadowing a built-in name) would be decoded
+            # by the stock codec — corrupting every update silently —
+            # so require name-resolution to reproduce this exact class.
+            try:
+                server_side = resolve_codec(self.codec.name)
+            except KeyError:
+                server_side = None
+            if server_side is None or \
+                    type(server_side) is not type(self.codec):
+                raise ValueError(
+                    f"codec {type(self.codec).__name__}(name="
+                    f"{self.codec.name!r}) cannot be reconstructed "
+                    f"server-side from its name; custom codecs work "
+                    f"only over the in-process transport")
             hello += self.codec.name.encode()
         transport.send_msg(self._sock, hello)
 

@@ -441,6 +441,10 @@ class DistributedTrainer(Trainer):
         self.worker_timeout = (None if worker_timeout is None
                                else float(worker_timeout))
         self.compression = compression
+        if compression is not None:
+            from distkeras_tpu.parallel.compression import resolve_codec
+
+            resolve_codec(compression)  # fail fast on a bad spec
         if self.worker_timeout is not None and self.worker_timeout <= 0:
             raise ValueError(
                 f"worker_timeout must be positive, got {worker_timeout}")
@@ -902,7 +906,9 @@ class DistributedTrainer(Trainer):
                             for k, v in stacked.items()}
                         attempts = 0
                         reconnect = False
-                        pending_commit = None  # (bytes, applied, total)
+                        # (bytes, applied, total, raw_nbytes) cached
+                        # across retry attempts of this commit_seq
+                        pending_commit = None
                         base_state = state  # pre-round snapshot: a
                         # retried window must not see optimizer
                         # moments / rng / step already advanced by the
@@ -921,39 +927,42 @@ class DistributedTrainer(Trainer):
                                     reconnect = False
                                 if self.fault_injector is not None:
                                     self.fault_injector(w, epoch, r)
-                                start_params = jax.tree_util.tree_map(
-                                    jnp.asarray, pulled)
-                                state = base_state.replace(
-                                    params=start_params)
-                                state, metrics = run_window(state,
-                                                            batches)
-                                if rule.payload_kind == "params":
-                                    payload = local = state.params
-                                else:
-                                    payload = rule.normalize_delta(
-                                        tree_sub(state.params,
-                                                 start_params), window)
-                                    local = None
-                                if codec is not None:
-                                    # Error feedback: fold the residual
-                                    # under-transmitted so far into this
-                                    # window's delta.  The encoding is
-                                    # cached per commit_seq: a retry
-                                    # whose first attempt died AFTER
-                                    # encoding resends the identical
-                                    # bytes (the server may have applied
-                                    # them and just lost the ack — seq
-                                    # dedupe returns the cached reply),
-                                    # so the residual is always computed
-                                    # against what the server actually
-                                    # absorbed.
-                                    if pending_commit is None:
+                                if pending_commit is None:
+                                    start_params = (
+                                        jax.tree_util.tree_map(
+                                            jnp.asarray, pulled))
+                                    state = base_state.replace(
+                                        params=start_params)
+                                    state, metrics = run_window(
+                                        state, batches)
+                                    if rule.payload_kind == "params":
+                                        payload = local = state.params
+                                    else:
+                                        payload = rule.normalize_delta(
+                                            tree_sub(state.params,
+                                                     start_params),
+                                            window)
+                                        local = None
+                                    if codec is not None:
+                                        # Error feedback: fold the
+                                        # residual under-transmitted so
+                                        # far into this window's delta;
+                                        # cache the encoding per
+                                        # commit_seq.
                                         total = tree_add(payload,
                                                          residual)
                                         pending_commit = (
                                             *codec.round_trip(total),
-                                            total)
-                                    encoded, applied, total = (
+                                            total, raw_nbytes(payload))
+                                # A retry with a cached encoding skips
+                                # the window recompute and resends the
+                                # IDENTICAL bytes: the server may have
+                                # applied them and lost only the ack
+                                # (seq dedupe returns the cached
+                                # reply), so the residual below always
+                                # matches what the server absorbed.
+                                if codec is not None:
+                                    encoded, applied, total, raw_n = (
                                         pending_commit)
                                     pulled = commit(
                                         encoded if client is not None
@@ -962,7 +971,7 @@ class DistributedTrainer(Trainer):
                                     residual = tree_sub(total, applied)
                                     pending_commit = None
                                     wire_bytes += len(encoded)
-                                    raw_bytes += raw_nbytes(payload)
+                                    raw_bytes += raw_n
                                 else:
                                     pulled = commit(
                                         payload,

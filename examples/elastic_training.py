@@ -26,6 +26,11 @@ def main():
                         help="worker id to hard-kill mid-run")
     parser.add_argument("--stall-worker", type=int, default=1,
                         help="worker id to stall once (transient)")
+    parser.add_argument("--compression", default=None,
+                        metavar="CODEC",
+                        help="compress commits on the wire: int8, "
+                             "bfloat16, topk[:frac] (error-feedback "
+                             "corrected)")
     args = parse_args_and_setup(parser)
     if args.checkpoint_dir or args.resume:
         raise SystemExit(
@@ -74,8 +79,14 @@ def main():
              batch_size=args.batch_size, num_epoch=args.epochs,
              learning_rate=args.learning_rate, worker_optimizer="adam",
              worker_retries=2, max_worker_failures=1,
-             worker_timeout=0.5, fault_injector=injector)
+             worker_timeout=0.5, fault_injector=injector,
+             compression=args.compression)
     t.train(data)
+    if args.compression:
+        wire = t.history["commit_wire_bytes"][-1]
+        raw = t.history["commit_raw_bytes"][-1]
+        print(f"[wire] {wire/1e6:.2f} MB committed vs {raw/1e6:.2f} MB "
+              f"raw ({wire/max(raw,1):.0%})")
 
     failures = t.history.get("worker_failures", [[]])[-1]
     retries = t.history.get("worker_round_retries", [[]])[-1]

@@ -100,6 +100,10 @@ def main():
         ("ADAG (host threads)", ADAG, {"fidelity": "host"}),
         ("DOWNPOUR (host, socket)", DOWNPOUR,
          {"fidelity": "host", "transport": "socket"}),
+        # lossy wire + error feedback must not cost convergence
+        ("DOWNPOUR (host, socket, int8 wire)", DOWNPOUR,
+         {"fidelity": "host", "transport": "socket",
+          "compression": "int8"}),
     ]:
         kw = {**async_kwargs, **extra}
         results.append(run(name, cls, cfg, data, kw, eval_data))
@@ -147,7 +151,10 @@ def main():
         "the faithful concurrent arm (free-running threads, mutex PS, "
         "emergent staleness — design 5a): their agreement with the "
         "emulated rows is the evidence that the on-mesh deterministic "
-        "staleness semantics (design 5b) match real asynchrony.",
+        "staleness semantics (design 5b) match real asynchrony.  The "
+        "'int8 wire' row adds commit compression with error feedback "
+        "(parallel/compression.py): its agreement shows the lossy wire "
+        "does not cost convergence either.",
     ]
     (REPO / "PARITY.md").write_text("\n".join(lines) + "\n")
     print(json.dumps({r["trainer"]: r["accuracy"] for r in results},

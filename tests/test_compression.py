@@ -181,3 +181,36 @@ def test_psclient_tree_payload_on_codec_connection():
                                    0.5, rtol=0.02)
         c.done()
         c.close()
+
+
+def test_bad_compression_spec_fails_at_construction():
+    with pytest.raises(KeyError):
+        DOWNPOUR(MLP, fidelity="host", compression="int-8")
+
+
+def test_custom_codec_rejected_on_socket_accepted_inprocess():
+    class Doubling(Int8Codec):  # shadows the built-in name
+        def encode_leaf(self, x):
+            return super().encode_leaf(2 * x)
+
+    kwargs = dict(fidelity="host", num_workers=2,
+                  communication_window=2, batch_size=16, num_epoch=1,
+                  learning_rate=0.05, compression=Doubling())
+    with pytest.raises(ValueError, match="reconstructed server-side"):
+        from distkeras_tpu.parallel.host_ps import PSClient
+
+        import numpy as np
+
+        from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                    PSServer)
+        from distkeras_tpu.parallel.update_rules import DownpourRule
+
+        center = {"w": np.zeros(2, np.float32)}
+        ps = HostParameterServer(DownpourRule(), center)
+        with PSServer(ps, center) as server:
+            PSClient(*server.address, worker_id=0, template=center,
+                     codec=Doubling())
+    # in-process: no wire, the custom codec is applied client-side
+    t = DOWNPOUR(MLP, transport="inprocess", **kwargs)
+    t.train(DATA)
+    assert np.isfinite(t.history["epoch_loss"]).all()

@@ -94,8 +94,8 @@ def test_batchnorm_and_embedding_mapping():
 
 def test_unsupported_layer_raises_by_name():
     arch = {"class_name": "Sequential", "config": {"layers": [
-        {"class_name": "LSTM", "config": {"units": 8}}]}}
-    with pytest.raises(NotImplementedError, match="LSTM"):
+        {"class_name": "GRU", "config": {"units": 8}}]}}
+    with pytest.raises(NotImplementedError, match="GRU"):
         from_keras_json(json.dumps(arch), input_shape=(5, 3))
 
 
@@ -166,3 +166,74 @@ def test_variable_length_input_needs_explicit_shape():
         from_keras_json(json.dumps(arch))
     spec, _ = from_keras_json(json.dumps(arch), input_shape=(12,))
     assert spec.input_shape == (12,)
+
+
+def _keras_bilstm():
+    return keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.Embedding(50, 8),
+        keras.layers.Bidirectional(keras.layers.LSTM(6)),
+        keras.layers.Dense(2),
+    ])
+
+
+def _keras_lstm_seq():
+    return keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Embedding(30, 5),
+        keras.layers.LSTM(4, return_sequences=True),
+        keras.layers.LSTM(3),
+        keras.layers.Dense(2),
+    ])
+
+
+@pytest.mark.parametrize("maker,shape", [
+    (_keras_bilstm, (12,)),
+    (_keras_lstm_seq, (10,)),
+])
+def test_lstm_forward_parity_with_keras(maker, shape):
+    """The reference's IMDB workflow shape: Embedding -> (Bi)LSTM ->
+    Dense, exact forward parity including stacked/return_sequences."""
+    m = maker()
+    spec, variables = from_keras(m)
+    rng = np.random.default_rng(3)
+    x = rng.integers(1, 30, size=(4, *shape)).astype(np.int32)
+    want = np.asarray(m(x))
+    got = np.asarray(spec.build().apply(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unsupported_variants_raise():
+    with pytest.raises(NotImplementedError, match="mask_zero"):
+        from_keras(keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(10, 4, mask_zero=True),
+            keras.layers.LSTM(3),
+        ]))
+    with pytest.raises(NotImplementedError, match="merge_mode"):
+        from_keras(keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(10, 4),
+            keras.layers.Bidirectional(keras.layers.LSTM(3),
+                                       merge_mode="sum"),
+        ]))
+    with pytest.raises(NotImplementedError, match="recurrent_activation"):
+        from_keras(keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(10, 4),
+            keras.layers.LSTM(3, recurrent_activation="hard_sigmoid"),
+        ]))
+
+
+def test_ingested_bilstm_trains():
+    spec, variables = from_keras(_keras_bilstm())
+    rng = np.random.default_rng(0)
+    from distkeras_tpu.data.dataset import Dataset
+
+    data = Dataset({
+        "features": rng.integers(1, 50, size=(256, 12)).astype(np.int32),
+        "label": rng.integers(0, 2, size=(256,)).astype(np.int32)})
+    t = SingleTrainer(spec.to_config(), worker_optimizer="adam",
+                      learning_rate=5e-3, batch_size=32, num_epoch=2)
+    t.train(data, initial_variables=variables)
+    assert np.isfinite(t.history["epoch_loss"]).all()
