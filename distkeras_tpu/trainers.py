@@ -89,10 +89,13 @@ class Trainer:
                  worker_optimizer="sgd", learning_rate=None,
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, num_epoch: int = 1, seed: int = 0,
-                 checkpoint_dir: str | None = None):
+                 checkpoint_dir: str | None = None,
+                 profile_dir: str | None = None):
         """``learning_rate``: float, optax schedule, or a JSON-friendly
         ``{"schedule": name, **kwargs}`` dict (see
-        ``workers.resolve_schedule``)."""
+        ``workers.resolve_schedule``).  ``profile_dir`` wraps the whole
+        ``train()`` in a ``jax.profiler`` trace written there (view
+        with TensorBoard / xprof)."""
         self.spec = _resolve_spec(model)
         self.model = self.spec.build()
         self.loss = loss
@@ -104,6 +107,7 @@ class Trainer:
         self.num_epoch = int(num_epoch)
         self.seed = int(seed)
         self.checkpoint_dir = checkpoint_dir
+        self.profile_dir = profile_dir
         self.training_time: float = 0.0
         self.history: dict[str, list] = {}
         self.trained_variables: dict | None = None
@@ -136,10 +140,14 @@ class Trainer:
         records ``history['eval_accuracy']`` at every epoch boundary
         (the reference notebooks' accuracy-vs-trainer comparison,
         done in-framework)."""
+        from distkeras_tpu.profiling import profiler_trace
+
         self._eval_dataset = eval_dataset
         start = time.time()
         try:
-            return self._train(dataset, initial_variables, resume_from)
+            with profiler_trace(self.profile_dir):
+                return self._train(dataset, initial_variables,
+                                   resume_from)
         finally:
             self.training_time = time.time() - start
 

@@ -55,6 +55,9 @@ def make_parser(description: str, **defaults) -> argparse.ArgumentParser:
                    help="write checkpoints here (enables --resume)")
     p.add_argument("--resume", default=None, metavar="DIR",
                    help="resume from a checkpoint directory")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the training "
+                        "run there (view with TensorBoard)")
     p.add_argument("--seed", type=int, default=0)
     return p
 

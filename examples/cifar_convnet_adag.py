@@ -39,7 +39,8 @@ def main():
                    batch_size=args.batch_size, num_epoch=args.epochs,
                    learning_rate=args.learning_rate,
                    worker_optimizer="adam", seed=args.seed,
-                   checkpoint_dir=args.checkpoint_dir)
+                   checkpoint_dir=args.checkpoint_dir,
+                   profile_dir=args.profile_dir)
     variables = trainer.train(data, resume_from=args.resume)
 
     metrics = evaluate_model(trainer.model, variables, data,

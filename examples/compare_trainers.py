@@ -35,7 +35,7 @@ def main():
     common = dict(worker_optimizer="adam",
                   learning_rate=args.learning_rate,
                   batch_size=args.batch_size, num_epoch=args.epochs,
-                  seed=args.seed)
+                  seed=args.seed, profile_dir=args.profile_dir)
     dist = dict(num_workers=args.workers,
                 communication_window=args.window)
 

@@ -67,7 +67,8 @@ def main():
                        num_epoch=args.epochs,
                        learning_rate=args.learning_rate,
                        worker_optimizer="adam", seed=args.seed,
-                       checkpoint_dir=args.checkpoint_dir)
+                       checkpoint_dir=args.checkpoint_dir,
+                       profile_dir=args.profile_dir)
     variables = trainer.train(table, resume_from=args.resume)
 
     with timed("criteo_predict"):

@@ -80,7 +80,8 @@ def main():
              learning_rate=args.learning_rate, worker_optimizer="adam",
              worker_retries=2, max_worker_failures=1,
              worker_timeout=0.5, fault_injector=injector,
-             compression=args.compression)
+             compression=args.compression,
+             profile_dir=args.profile_dir)
     t.train(data)
     if args.compression:
         wire = t.history["commit_wire_bytes"][-1]

@@ -52,7 +52,8 @@ def main():
                      batch_size=args.batch_size, num_epoch=args.epochs,
                      rho=args.rho, learning_rate=args.learning_rate,
                      fidelity=args.fidelity, seed=args.seed,
-                     checkpoint_dir=args.checkpoint_dir)
+                     checkpoint_dir=args.checkpoint_dir,
+                     profile_dir=args.profile_dir)
     variables = trainer.train(data, resume_from=args.resume)
     metrics = evaluate_model(trainer.model, variables, data,
                              batch_size=64)

@@ -79,7 +79,7 @@ def main():
                   worker_optimizer="adam",
                   learning_rate=args.learning_rate,
                   batch_size=args.batch_size, num_epoch=args.epochs,
-                  seed=args.seed)
+                  seed=args.seed, profile_dir=args.profile_dir)
     if args.trainer == "single":
         t = trainers.SingleTrainer(spec.to_config(), **common)
     elif args.trainer == "sync":

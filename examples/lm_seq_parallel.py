@@ -31,7 +31,13 @@ def main():
                              "[q_chunk, T_local] per hop)")
     add_data_option(parser)
     args = parse_args_and_setup(parser)
+    from distkeras_tpu.profiling import profiler_trace
 
+    with profiler_trace(args.profile_dir):
+        _run(args)
+
+
+def _run(args):
     import time
 
     import jax

@@ -41,7 +41,8 @@ def main():
     common = dict(worker_optimizer="adam",
                   learning_rate=args.learning_rate,
                   batch_size=args.batch_size, num_epoch=args.epochs,
-                  seed=args.seed, checkpoint_dir=args.checkpoint_dir)
+                  seed=args.seed, checkpoint_dir=args.checkpoint_dir,
+                  profile_dir=args.profile_dir)
     dist = dict(num_workers=args.workers,
                 communication_window=args.window)
     name = args.trainer

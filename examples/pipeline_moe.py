@@ -22,7 +22,13 @@ def main():
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--d-model", type=int, default=16)
     args = parse_args_and_setup(parser)
+    from distkeras_tpu.profiling import profiler_trace
 
+    with profiler_trace(args.profile_dir):
+        _run(args)
+
+
+def _run(args):
     import json
 
     import jax

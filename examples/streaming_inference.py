@@ -41,7 +41,8 @@ def main():
     t = SingleTrainer(cfg, worker_optimizer="adam",
                       learning_rate=args.learning_rate,
                       batch_size=args.batch_size,
-                      num_epoch=args.epochs)
+                      num_epoch=args.epochs,
+                      profile_dir=args.profile_dir)
     variables = t.train(data)
     print(f"[streaming] trained: epoch loss "
           f"{t.history['epoch_loss'][0]:.3f} -> "

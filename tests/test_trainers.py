@@ -219,3 +219,12 @@ def test_numpy_scalar_learning_rate_passes_through():
     resolve_optimizer("sgd", jnp.asarray(1e-2))  # 0-d array scalar
     t = AEASGD(MLP, num_workers=2, learning_rate=np.float32(0.01))
     assert abs(t.alpha - 0.05) < 1e-7  # rho=5.0 default
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    data = datasets.synthetic_classification(128, (8,), 4, seed=0)
+    t = SingleTrainer(MLP, batch_size=32, num_epoch=1,
+                      learning_rate=0.05, profile_dir=str(tmp_path))
+    t.train(data)
+    profiles = list(tmp_path.rglob("*.xplane.pb"))
+    assert profiles, list(tmp_path.rglob("*"))
