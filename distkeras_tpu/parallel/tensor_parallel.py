@@ -131,6 +131,28 @@ def tree_shardings(mesh: Mesh, tree,
         tree)
 
 
+def stacked_tree_shardings(mesh: Mesh, tree, rules: Sequence[Rule],
+                           axis_name: str | None = None) -> Any:
+    """Shardings for a tree whose every leaf carries a leading stacked
+    axis (e.g. vmapped per-worker ``TrainState``s, ``[W, ...]``): the
+    stacked axis shards over ``axis_name`` (default: the mesh's worker
+    axis) and the remaining dims follow the TP rules — the layout of
+    tensor-parallel workers under the async PS family."""
+    from distkeras_tpu.mesh import WORKER_AXIS
+
+    axis = WORKER_AXIS if axis_name is None else axis_name
+
+    def f(path, leaf):
+        # rules (incl. callables and the rank guard) see the UNSTACKED
+        # leaf, exactly as in the non-stacked path
+        unstacked = jax.ShapeDtypeStruct(tuple(leaf.shape[1:]),
+                                         getattr(leaf, "dtype", None))
+        spec = spec_for(_path_str(path), unstacked, rules)
+        return NamedSharding(mesh, P(axis, *spec))
+
+    return jax.tree_util.tree_map_with_path(f, tree)
+
+
 def shard_tree(mesh: Mesh, tree, rules: Sequence[Rule]):
     """Place ``tree`` on ``mesh`` with the rules' shardings (single
     ``jax.device_put`` per leaf; GSPMD handles everything downstream)."""

@@ -412,7 +412,8 @@ class DistributedTrainer(Trainer):
                  max_worker_failures: int = 0,
                  worker_retries: int = 0,
                  worker_timeout: float | None = None,
-                 fault_injector=None, compression=None, **kwargs):
+                 fault_injector=None, compression=None,
+                 model_parallel: int = 1, tp_rules=None, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -436,7 +437,14 @@ class DistributedTrainer(Trainer):
         only) compresses each delta-family commit on the wire with
         client-side error feedback; wire/raw byte totals land in
         ``history['commit_wire_bytes']`` / ``['commit_raw_bytes']``
-        (process-local under multi-host)."""
+        (process-local under multi-host).  ``model_parallel=k`` runs
+        every emulated worker tensor-parallel over a ``(workers,
+        model)`` mesh — worker states shard ``P(workers, *tp_spec)``
+        (``tp_rules`` defaulting to the family's Megatron-style rules),
+        the PS center shards by the TP specs alone, and GSPMD derives
+        both the TP collectives inside each worker and the commit
+        reduction across workers; for PS-family models too big for one
+        chip (beyond the reference, which was DP-only)."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -448,6 +456,16 @@ class DistributedTrainer(Trainer):
         self.fault_injector = fault_injector
         self.worker_timeout = (None if worker_timeout is None
                                else float(worker_timeout))
+        self.model_parallel = int(model_parallel)
+        self.tp_rules = tp_rules
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"model_parallel must be >= 1, got {model_parallel}")
+        if self.model_parallel > 1 and fidelity == "host":
+            raise ValueError(
+                "model_parallel > 1 needs the on-mesh emulated "
+                "fidelities (the host arm's workers are per-thread "
+                "device programs, DP-only)")
         self.compression = compression
         if compression is not None:
             from distkeras_tpu.parallel.compression import resolve_codec
@@ -506,10 +524,30 @@ class DistributedTrainer(Trainer):
 
         worker_keys = jax.random.split(
             jax.random.key(self.seed + 1), num_workers)
-        if pc > 1:
+        mp = self.model_parallel
+        if pc > 1 and mp == 1:
             worker_keys = worker_keys[local_workers.start:
                                       local_workers.stop]
-        worker_states = jax.vmap(make_worker)(worker_keys)
+        if mp > 1:
+            tp_rules_resolved = (
+                self.tp_rules if self.tp_rules is not None
+                else tensor_parallel.rules_for(self.spec.family))
+            m_tp = mesh_lib.create_mesh(num_workers, model_parallel=mp)
+            # Worker states are BORN sharded: without out_shardings the
+            # [W, ...] stack (params + optimizer moments) would
+            # materialize on one device before placement — an OOM for
+            # exactly the models TP exists for.  (The single center
+            # copy from model.init still lands on one device first —
+            # the same init limitation SyncTrainer's TP path has.)
+            ws_struct = jax.eval_shape(jax.vmap(make_worker),
+                                       worker_keys)
+            ws_sharding = tensor_parallel.stacked_tree_shardings(
+                m_tp, ws_struct, tp_rules_resolved)
+            worker_states = jax.jit(
+                jax.vmap(make_worker),
+                out_shardings=ws_sharding)(worker_keys)
+        else:
+            worker_states = jax.vmap(make_worker)(worker_keys)
 
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
@@ -541,7 +579,14 @@ class DistributedTrainer(Trainer):
                 ckpt_state["ps"], ckpt_state["workers"],
                 ckpt_state["perm_key"])
 
-        placement = mesh_lib.place_workers(num_workers)
+        if mp > 1:
+            # tensor-parallel workers: the (workers, model) mesh built
+            # at init time (no vmap fallback — TP is a layout over real
+            # devices)
+            placement = mesh_lib.WorkerPlacement(
+                mesh=m_tp, mesh_workers=num_workers, vmap_workers=1)
+        else:
+            placement = mesh_lib.place_workers(num_workers)
         if pc > 1 and (placement.mesh is None
                        or placement.mesh_workers != num_workers):
             raise ValueError(
@@ -552,11 +597,24 @@ class DistributedTrainer(Trainer):
             m = placement.mesh
             rep = NamedSharding(m, P())
             row = NamedSharding(m, P(mesh_lib.WORKER_AXIS))
-            # Each process contributes its own workers' states (and the
-            # full replica of the PS state) to the global arrays.
-            worker_states = mesh_lib.global_batch_from_local(
-                row, worker_states)
-            ps_state = mesh_lib.global_batch_from_local(rep, ps_state)
+            if mp > 1:
+                # PS center sharded by the TP specs (worker states were
+                # born sharded above; a msgpack resume replaced them
+                # with host arrays, which round_jit's in_shardings
+                # place)
+                ps_sharding = tensor_parallel.tree_shardings(
+                    m, ps_state, tp_rules_resolved)
+                ps_state = mesh_lib.global_batch_from_local(
+                    ps_sharding, ps_state)
+            else:
+                ps_sharding, ws_sharding = rep, row
+                # Each process contributes its own workers' states (and
+                # the full replica of the PS state) to the global
+                # arrays.
+                worker_states = mesh_lib.global_batch_from_local(
+                    ws_sharding, worker_states)
+                ps_state = mesh_lib.global_batch_from_local(
+                    ps_sharding, ps_state)
             if resume_sharded:
                 # the sharded layout carries the device state; the
                 # (host-local, process-identical) permutation key rides
@@ -572,8 +630,8 @@ class DistributedTrainer(Trainer):
                                np.uint32)))
             round_jit = jax.jit(
                 round_fn,
-                in_shardings=(rep, row, row, rep),
-                out_shardings=(rep, row, rep))
+                in_shardings=(ps_sharding, ws_sharding, row, rep),
+                out_shardings=(ps_sharding, ws_sharding, rep))
             # worker-0 row of the model state (batch stats etc.),
             # sliced on device; jitted ONCE so epoch-boundary eval and
             # the end-of-train extraction share the compiled program

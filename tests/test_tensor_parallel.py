@@ -104,3 +104,66 @@ def test_tp_matches_dp_training(devices, config, loss, data):
     dp, tp_ = run(1), run(2)
     np.testing.assert_allclose(tp_, dp, rtol=2e-4, atol=2e-5)
     assert dp[-1] < dp[0], dp
+
+
+def test_ps_family_tensor_parallel_matches_dp():
+    """ADAG with tensor-parallel workers over a (workers, model) mesh:
+    TP is a layout change, not an algorithm change — the loss history
+    must match the DP-only run of the same configuration."""
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import ADAG
+
+    cfg = model_config("transformer_lm", (16,), input_dtype="int32",
+                       vocab_size=64, num_layers=1, d_model=32,
+                       num_heads=2, max_len=16, dtype="float32")
+    data = datasets.lm_synth(256, seq_len=16, vocab_size=64, seed=0)
+    kwargs = dict(num_workers=4, communication_window=2, batch_size=8,
+                  num_epoch=1, learning_rate=1e-2,
+                  loss="sparse_categorical_crossentropy",
+                  worker_optimizer="adam", seed=3)
+    dp = ADAG(cfg, **kwargs)
+    dp.train(data)
+    tp = ADAG(cfg, model_parallel=2, **kwargs)
+    tp.train(data)
+    np.testing.assert_allclose(tp.history["round_loss"],
+                               dp.history["round_loss"],
+                               rtol=2e-4, atol=2e-5)
+    assert (dp.history["round_loss"][-1]
+            < dp.history["round_loss"][0]), dp.history["round_loss"]
+    # the TP run really places params on the model axis
+    from distkeras_tpu.mesh import MODEL_AXIS
+
+    specs = {
+        str(getattr(leaf, "sharding", None) and leaf.sharding.spec)
+        for leaf in jax.tree_util.tree_leaves(
+            tp.trained_variables["params"])}
+    assert any(MODEL_AXIS in s for s in specs), specs
+
+
+def test_ps_family_tp_rejects_host_fidelity():
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import ADAG
+
+    cfg = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    with pytest.raises(ValueError, match="DP-only"):
+        ADAG(cfg, fidelity="host", model_parallel=2)
+
+
+def test_stacked_shardings_check_unstacked_rank():
+    """Rule rank errors fire against the UNSTACKED leaf, matching the
+    non-stacked path (a too-long spec must not slip past the guard
+    because of the worker axis)."""
+    import jax.numpy as jnp
+
+    from distkeras_tpu.mesh import MODEL_AXIS
+
+    mesh = mesh_lib.create_mesh(4, model_parallel=2)
+    stacked = {"bias": jnp.zeros((4, 6))}  # [W, n]: unstacked rank 1
+    with pytest.raises(ValueError, match="rank-2 spec"):
+        tp.stacked_tree_shardings(
+            mesh, stacked, ((r"bias$", P(None, MODEL_AXIS)),))
+    # a correct rank-1 rule prepends the worker axis
+    sh = tp.stacked_tree_shardings(
+        mesh, stacked, ((r"bias$", P(MODEL_AXIS)),))
+    assert sh["bias"].spec == P(mesh_lib.WORKER_AXIS, MODEL_AXIS)
