@@ -169,6 +169,24 @@ class Dataset:
         perm = np.random.default_rng(seed).permutation(self._num_rows)
         return Dataset({k: v[perm] for k, v in self._columns.items()})
 
+    def train_test_split(self, test_fraction: float = 0.2,
+                         seed: int = 0) -> tuple["Dataset", "Dataset"]:
+        """Shuffled ``(train, test)`` split — the holdout idiom the
+        reference notebooks did with Spark ``randomSplit``.  Both parts
+        are non-empty or this raises."""
+        if not 0.0 < test_fraction < 1.0:
+            raise ValueError(
+                f"test_fraction must be in (0, 1), got {test_fraction}")
+        n_test = int(round(self._num_rows * test_fraction))
+        if n_test == 0 or n_test == self._num_rows:
+            raise ValueError(
+                f"split of {self._num_rows} rows at {test_fraction} "
+                f"leaves an empty part")
+        perm = np.random.default_rng(seed).permutation(self._num_rows)
+        cols = self._columns
+        return (Dataset({k: v[perm[n_test:]] for k, v in cols.items()}),
+                Dataset({k: v[perm[:n_test]] for k, v in cols.items()}))
+
     def concat(self, other: "Dataset") -> "Dataset":
         if set(self.column_names) != set(other.column_names):
             raise ValueError("column sets differ")

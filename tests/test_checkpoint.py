@@ -283,3 +283,25 @@ def test_incomplete_sharded_save_is_invisible(tmp_path):
     assert not has_sharded(tmp_path)  # no pointer
     (root / "LATEST").write_text("state_epoch3")
     assert not has_sharded(tmp_path)  # pointer to nothing
+
+
+def test_adag_tensor_parallel_kill_and_resume_bitwise(tmp_path):
+    """msgpack kill/resume also covers the TP-sharded PS state (the
+    template is sharded; restored host arrays re-place via the jit
+    contract)."""
+    kwargs = dict(num_workers=4, model_parallel=2,
+                  communication_window=2, batch_size=16, num_epoch=2,
+                  learning_rate=0.05, seed=2)
+    ref = ADAG(MLP, **kwargs)
+    ref.train(DATA)
+
+    part = ADAG(MLP, checkpoint_dir=str(tmp_path),
+                **{**kwargs, "num_epoch": 1})
+    part.train(DATA)
+    resumed = ADAG(MLP, **kwargs)
+    resumed.train(DATA, resume_from=str(tmp_path))
+
+    for a, b in zip(_leaves(ref.trained_variables),
+                    _leaves(resumed.trained_variables)):
+        np.testing.assert_array_equal(a, b)
+    assert resumed.history["round_loss"] == ref.history["round_loss"]

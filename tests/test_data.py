@@ -265,3 +265,20 @@ def test_csv_edge_cases(tmp_path):
     # reserved column name
     with pytest.raises(ValueError, match="file"):
         Dataset({"file": np.ones(2)}).to_npz(tmp_path / "f")
+
+
+def test_train_test_split():
+    ds = Dataset({"x": np.arange(100), "y": np.arange(100) % 3})
+    train, test = ds.train_test_split(0.25, seed=1)
+    assert len(train) == 75 and len(test) == 25
+    # disjoint, exhaustive, rows stay aligned across columns
+    assert sorted(np.concatenate([train["x"], test["x"]])) == list(
+        range(100))
+    np.testing.assert_array_equal(train["y"], train["x"] % 3)
+    # deterministic per seed
+    t2, _ = ds.train_test_split(0.25, seed=1)
+    np.testing.assert_array_equal(train["x"], t2["x"])
+    with pytest.raises(ValueError, match="test_fraction"):
+        ds.train_test_split(1.5)
+    with pytest.raises(ValueError, match="empty part"):
+        Dataset({"x": np.arange(2)}).train_test_split(0.1)
