@@ -18,8 +18,11 @@ from _common import (add_data_option, load_dataset,
 
 
 def main():
+    # sgd @ 0.05 is the PARITY.md-validated setup: the async family's
+    # summed delta commits want plain-sgd scale (adam-scaled deltas
+    # overshoot the center, making async look falsely broken)
     parser = make_parser(__doc__, rows=4096, epochs=2, batch_size=32,
-                         workers=4, window=2, learning_rate=3e-3)
+                         workers=4, window=2, learning_rate=0.05)
     add_data_option(parser)
     args = parse_args_and_setup(parser)
 
@@ -32,22 +35,43 @@ def main():
         args, lambda: datasets.mnist_synth(args.rows,
                                            seed=args.seed))
     cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
-    common = dict(worker_optimizer="adam",
+    common = dict(worker_optimizer="sgd",
                   learning_rate=args.learning_rate,
                   batch_size=args.batch_size, num_epoch=args.epochs,
                   seed=args.seed, profile_dir=args.profile_dir)
     dist = dict(num_workers=args.workers,
                 communication_window=args.window)
+    # elastic family: the paper's stability condition couples alpha =
+    # lr * rho; rescale the flag by the same 0.02/0.05 ratio the
+    # parity script uses so --learning-rate drives every run
+    elastic = {**common, "learning_rate": args.learning_rate * 0.4}
+    # DOWNPOUR commits the RAW window-summed delta (no normalization —
+    # that omission is what ADAG fixes), so its stable lr scales like
+    # 1/(workers*window); DynSGD scales commits by 1/(staleness+1) but
+    # not by the window, so it wants ~1/window.  Measured on this
+    # config: downpour 0.05 -> chance, 0.05/8 -> 0.85; dynsgd 0.05 ->
+    # 0.30, 0.025 -> 0.81.
+    # ADAG window-normalizes but still sums W commits per round, so it
+    # wants ~1/workers (measured: 0.05 -> 0.59, 0.0125 -> 0.92).
+    downpour = {**common, "learning_rate":
+                args.learning_rate / (args.workers * args.window)}
+    adag = {**common,
+            "learning_rate": args.learning_rate / args.workers}
+    dynsgd = {**common,
+              "learning_rate": args.learning_rate / args.window}
 
     runs = {
         "single": trainers.SingleTrainer(cfg, **common),
         "sync": trainers.SyncTrainer(cfg, num_workers=args.workers,
                                      **common),
-        "downpour": trainers.DOWNPOUR(cfg, **dist, **common),
-        "adag": trainers.ADAG(cfg, **dist, **common),
-        "aeasgd": trainers.AEASGD(cfg, **dist, **common),
-        "eamsgd": trainers.EAMSGD(cfg, **dist, **common),
-        "dynsgd": trainers.DynSGD(cfg, **dist, **common),
+        "downpour": trainers.DOWNPOUR(cfg, **dist, **downpour),
+        "adag": trainers.ADAG(cfg, **dist, **adag),
+        "aeasgd": trainers.AEASGD(cfg, rho=2.5, **dist, **elastic),
+        # EAMSGD = the elastic law + Nesterov momentum workers (plain
+        # sgd would degenerate it to AEASGD)
+        "eamsgd": trainers.EAMSGD(cfg, rho=2.5, **dist, **{
+            **elastic, "worker_optimizer": "nesterov"}),
+        "dynsgd": trainers.DynSGD(cfg, **dist, **dynsgd),
     }
 
     rows = []
