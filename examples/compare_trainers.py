@@ -35,8 +35,9 @@ def main():
         args, lambda: datasets.mnist_synth(args.rows,
                                            seed=args.seed))
     cfg = model_config("mlp", (28, 28, 1), num_classes=10, hidden=(64,))
-    common = dict(worker_optimizer="sgd",
-                  learning_rate=args.learning_rate,
+    # plain-sgd workers (the Trainer default; EAMSGD keeps its own
+    # nesterov default) — adam-scaled deltas overshoot the PS center
+    common = dict(learning_rate=args.learning_rate,
                   batch_size=args.batch_size, num_epoch=args.epochs,
                   seed=args.seed, profile_dir=args.profile_dir)
     dist = dict(num_workers=args.workers,
@@ -67,10 +68,8 @@ def main():
         "downpour": trainers.DOWNPOUR(cfg, **dist, **downpour),
         "adag": trainers.ADAG(cfg, **dist, **adag),
         "aeasgd": trainers.AEASGD(cfg, rho=2.5, **dist, **elastic),
-        # EAMSGD = the elastic law + Nesterov momentum workers (plain
-        # sgd would degenerate it to AEASGD)
-        "eamsgd": trainers.EAMSGD(cfg, rho=2.5, **dist, **{
-            **elastic, "worker_optimizer": "nesterov"}),
+        # EAMSGD = the elastic law + its default Nesterov workers
+        "eamsgd": trainers.EAMSGD(cfg, rho=2.5, **dist, **elastic),
         "dynsgd": trainers.DynSGD(cfg, **dist, **dynsgd),
     }
 

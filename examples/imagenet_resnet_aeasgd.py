@@ -18,7 +18,7 @@ from _common import (add_data_option, load_dataset,
 
 
 def main():
-    parser = make_parser(__doc__, rows=256, epochs=2, batch_size=4,
+    parser = make_parser(__doc__, rows=256, epochs=None, batch_size=4,
                          workers=8, window=2, learning_rate=0.02)
     parser.add_argument("--image-size", type=int, default=32)
     parser.add_argument("--num-classes", type=int, default=10)
@@ -30,6 +30,12 @@ def main():
                         default="faithful")
     add_data_option(parser)
     args = parse_args_and_setup(parser)
+    if args.epochs is None:
+        # conv models crawl on the XLA:CPU mesh (grouped-conv slow
+        # path, PERF.md §10); TPU keeps the longer run
+        import jax
+
+        args.epochs = 1 if jax.default_backend() == "cpu" else 2
 
     from distkeras_tpu.data import datasets
     from distkeras_tpu.evaluators import evaluate_model
