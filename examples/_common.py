@@ -166,3 +166,18 @@ def timed(label: str):
             print(f"[{label}] {time.time() - self.t0:.2f}s")
 
     return _Timer()
+
+
+def resolve_platform_defaults(args, **tiers):
+    """Fill ``None``-defaulted size knobs per backend: each kwarg is
+    ``attr=(cpu_value, other_value)``.  Conv demos need smaller CPU
+    sizes — XLA:CPU lowers the PS round's batched-parameter convs
+    through a very slow grouped-conv path, while the same program is
+    faster than sequential stepping on TPU (PERF.md §10).  Call after
+    ``parse_args_and_setup`` (the backend pin must land first)."""
+    import jax
+
+    on_cpu = jax.default_backend() == "cpu"
+    for name, (cpu_value, other_value) in tiers.items():
+        if getattr(args, name) is None:
+            setattr(args, name, cpu_value if on_cpu else other_value)

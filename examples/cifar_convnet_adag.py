@@ -13,7 +13,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 from _common import (add_data_option, load_dataset,
-                     make_parser, parse_args_and_setup, report)
+                     make_parser, parse_args_and_setup, report,
+                     resolve_platform_defaults)
 
 
 def main():
@@ -23,18 +24,7 @@ def main():
                          workers=4, window=2, learning_rate=2e-3)
     add_data_option(parser)
     args = parse_args_and_setup(parser)
-    # Platform-sized defaults: XLA:CPU lowers the PS round's vmapped
-    # (batched-parameter) convs through a very slow grouped-conv path,
-    # so the --devices CPU mesh gets a small demo; TPU (where the same
-    # program is 5.6x faster than sequential stepping — PERF.md §10)
-    # keeps the full-size run.
-    import jax
-
-    on_cpu = jax.default_backend() == "cpu"
-    if args.rows is None:
-        args.rows = 512 if on_cpu else 2048
-    if args.epochs is None:
-        args.epochs = 1 if on_cpu else 2
+    resolve_platform_defaults(args, rows=(512, 2048), epochs=(1, 2))
 
     import numpy as np
 

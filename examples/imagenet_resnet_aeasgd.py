@@ -14,7 +14,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 from _common import (add_data_option, load_dataset,
-                     make_parser, parse_args_and_setup, report)
+                     make_parser, parse_args_and_setup, report,
+                     resolve_platform_defaults)
 
 
 def main():
@@ -30,12 +31,7 @@ def main():
                         default="faithful")
     add_data_option(parser)
     args = parse_args_and_setup(parser)
-    if args.epochs is None:
-        # conv models crawl on the XLA:CPU mesh (grouped-conv slow
-        # path, PERF.md §10); TPU keeps the longer run
-        import jax
-
-        args.epochs = 1 if jax.default_backend() == "cpu" else 2
+    resolve_platform_defaults(args, epochs=(1, 2))
 
     from distkeras_tpu.data import datasets
     from distkeras_tpu.evaluators import evaluate_model

@@ -114,6 +114,18 @@ def main():
             and ps_resumed.history["staleness"]
             == ps_full.history["staleness"])
 
+    # Async PS with tensor-parallel workers across hosts: (4 workers,
+    # 2 model) mesh spanning both processes, worker states born
+    # sharded, PS center sharded by the TP specs — the losses must
+    # match the DP-only ADAG run of the same shape when algorithmic
+    # config matches (here we just require identical telemetry on both
+    # processes and convergence: the DP run above uses 8 workers, so
+    # cross-checking is within this arm only).
+    ps_tp = ADAG(cfg, num_workers=4, model_parallel=2,
+                 communication_window=2, batch_size=8, num_epoch=1,
+                 learning_rate=0.05)
+    ps_tp.train(data)
+
     # Cross-host faithful PS (design 5a over real TCP): process 0
     # hosts the server, both processes run 2 of the 4 workers; every
     # process must report identical global telemetry and center.
@@ -141,6 +153,9 @@ def main():
                          for x in tp.history["epoch_loss"]],
         "tp_resume_match": tp_resume_match,
         "ps_resume_match": ps_resume_match,
+        "ps_tp_round_loss": [round(x, 6)
+                             for x in ps_tp.history["round_loss"]],
+        "ps_tp_staleness": sorted(ps_tp.history["staleness"][-1]),
         "host_ps_epoch_loss": [round(x, 6) for x in
                                host_ps.history["epoch_loss"]],
         "host_ps_commits": len(host_ps.history["staleness"][-1]),

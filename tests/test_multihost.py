@@ -74,6 +74,12 @@ def test_two_process_cluster_trains_and_agrees(num_processes,
     # ...and the same for the async PS family's sharded worker states
     assert a["ps_resume_match"] is True
     assert b["ps_resume_match"] is True
+    # async PS with tensor-parallel workers spanning both processes:
+    # identical telemetry everywhere, full staleness spread, learning
+    assert a["ps_tp_round_loss"] == b["ps_tp_round_loss"]
+    assert a["ps_tp_staleness"] == b["ps_tp_staleness"] == [0, 1, 2, 3]
+    tp_curve = a["ps_tp_round_loss"]
+    assert tp_curve[-1] < tp_curve[0], tp_curve
     # cross-host faithful PS (socket transport, PS on process 0):
     # identical global telemetry and final center on both processes,
     # every worker's commits landed, training made progress
