@@ -131,7 +131,10 @@ def main():
         f"Setup: MLP (16,)->8, {args.rows} rows, {args.workers} workers, "
         f"batch {args.batch}/worker, window {args.window}, "
         f"{args.epochs} epochs, 8-virtual-device CPU mesh.  Full curves "
-        "in `parity.json`.",
+        "in `parity.json`; rendered in `PARITY.png` "
+        "(scripts/plot_parity.py).",
+        "",
+        "![convergence curves + accuracy table](PARITY.png)",
         "",
         "| Trainer | final loss | eval accuracy | gap vs sync | time (s) |",
         "|---|---|---|---|---|",

@@ -1,0 +1,64 @@
+"""Render PARITY.png from parity.json — the rebuild's version of the
+reference README's convergence plots (SURVEY.md §6: the reference
+published plots, not numbers; here both exist).
+
+Run after scripts/parity.py:  python scripts/plot_parity.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main():
+    payload = json.loads((REPO / "parity.json").read_text())
+    results = payload["results"]
+
+    fig, (ax_loss, ax_acc) = plt.subplots(
+        1, 2, figsize=(11, 4.2), gridspec_kw={"width_ratios": [3, 2]})
+
+    for r in results:
+        curve = r["loss_curve"]
+        # per-round curves for async trainers, per-epoch for sync:
+        # normalize the x axis to fraction of the training budget
+        xs = [i / max(len(curve) - 1, 1) for i in range(len(curve))]
+        style = "--" if "host" in r["trainer"] else "-"
+        width = 2.4 if r["trainer"] == "SyncTrainer" else 1.4
+        ax_loss.plot(xs, curve, style, linewidth=width,
+                     label=r["trainer"])
+    ax_loss.set_xlabel("fraction of training budget")
+    ax_loss.set_ylabel("training loss")
+    ax_loss.set_title("async PS family vs the synchronous control arm")
+    ax_loss.legend(fontsize=7.5)
+    ax_loss.grid(alpha=0.3)
+
+    names = [r["trainer"] for r in results]
+    accs = [r["accuracy"] for r in results]
+    bars = ax_acc.barh(range(len(names)), accs, color=[
+        "#444444" if n == "SyncTrainer" else
+        "#2a6fb0" if "host" not in n else "#7fb02a" for n in names])
+    ax_acc.set_yticks(range(len(names)), names, fontsize=7.5)
+    ax_acc.invert_yaxis()
+    ax_acc.set_xlim(0, 1)
+    ax_acc.set_xlabel("eval accuracy (same budget)")
+    ax_acc.grid(axis="x", alpha=0.3)
+    for bar, acc in zip(bars, accs):
+        ax_acc.text(acc + 0.01, bar.get_y() + bar.get_height() / 2,
+                    f"{acc:.3f}", va="center", fontsize=7)
+
+    fig.tight_layout()
+    out = REPO / "PARITY.png"
+    fig.savefig(out, dpi=130)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
