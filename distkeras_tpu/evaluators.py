@@ -16,6 +16,19 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.predictors import ModelPredictor
 
 
+def _normalize_class_labels(labels: np.ndarray) -> np.ndarray:
+    """Class ids from a label column that may be integer ids ``[N]``, a
+    column vector of ids ``[N, 1]`` (squeezed — argmaxing it would zero
+    every label), or one-hot rows ``[N, C]`` (argmax'd; the reference's
+    OneHotTransformer workflow)."""
+    if labels.ndim > 1:
+        if labels.shape[-1] > 1:
+            labels = np.argmax(labels, axis=-1)
+        else:
+            labels = np.squeeze(labels, axis=-1)
+    return labels
+
+
 def _aligned_pred_labels(dataset: Dataset, prediction_col: str,
                          label_col: str) -> tuple[np.ndarray, np.ndarray]:
     """Class-id (pred, labels) from a scored dataset.  Predictions may
@@ -33,12 +46,19 @@ def _aligned_pred_labels(dataset: Dataset, prediction_col: str,
             pred = np.squeeze(pred, axis=-1)
     labels = np.asarray(dataset[label_col])
     if labels.ndim > pred.ndim:
-        # a trailing axis of width 1 is a column vector of class ids,
-        # not a one-hot encoding
-        if labels.shape[-1] > 1:
-            labels = np.argmax(labels, axis=-1)
-        else:
-            labels = np.squeeze(labels, axis=-1)
+        labels = _normalize_class_labels(labels)
+    if np.issubdtype(pred.dtype, np.floating):
+        # a float prediction column that isn't integral class ids is a
+        # score column (e.g. a single-logit binary model): comparing it
+        # raw against labels would silently return ~0 accuracy
+        if pred.size and not np.array_equal(pred, np.round(pred)):
+            raise ValueError(
+                f"prediction column {prediction_col!r} holds "
+                f"non-integral float scores, not class ids; for "
+                f"one-score-per-row binary outputs use "
+                f"BinaryClassificationEvaluator (or argmax multi-class "
+                f"scores into class ids first)")
+        pred = pred.astype(np.int64)
     if labels.shape != pred.shape:
         raise ValueError(
             f"prediction shape {pred.shape} and label shape "
@@ -67,29 +87,39 @@ class ClassificationEvaluator:
     analogue the reference notebooks used (SURVEY.md §2.1 Evaluators).
 
     ``metric``: ``'f1'`` (default, like pyspark), ``'precision'``,
-    ``'recall'``, or ``'accuracy'``; ``average`` as in
-    ``ops.metrics.precision_recall_f1``.  ``num_classes`` is inferred
-    from the data (max id + 1) when not given — except for
-    ``average='macro'``, whose denominator is the class count itself:
-    there an explicit ``num_classes`` is required, otherwise the score
-    would silently depend on which classes happen to appear in the
-    evaluated split.
+    ``'recall'``, ``'accuracy'``, or ``'auc'`` (one-vs-rest macro
+    AUC-ROC via ``ops.metrics.macro_auc_roc`` — needs the prediction
+    column to hold per-class scores ``[N, C]``, not argmax'd class
+    ids); ``average`` as in ``ops.metrics.precision_recall_f1``
+    (``'auc'`` supports ``'macro'`` only).  ``num_classes`` is inferred
+    from the data (max id + 1, or the score width for ``'auc'``) when
+    not given — except for ``average='macro'`` on the count-based
+    metrics, whose denominator is the class count itself: there an
+    explicit ``num_classes`` is required, otherwise the score would
+    silently depend on which classes happen to appear in the evaluated
+    split.
     """
 
     def __init__(self, metric: str = "f1", average: str = "weighted",
                  prediction_col: str = "prediction",
                  label_col: str = "label",
                  num_classes: int | None = None):
-        if metric not in ("f1", "precision", "recall", "accuracy"):
+        if metric not in ("f1", "precision", "recall", "accuracy",
+                          "auc"):
             raise ValueError(
                 f"unknown metric {metric!r}; expected 'f1', "
-                f"'precision', 'recall', or 'accuracy'")
-        if average not in ("weighted", "macro", "micro"):
+                f"'precision', 'recall', 'accuracy', or 'auc'")
+        if metric == "auc":
+            if average != "macro":
+                raise ValueError(
+                    f"metric='auc' supports average='macro' only "
+                    f"(one-vs-rest), got {average!r}")
+        elif average not in ("weighted", "macro", "micro"):
             raise ValueError(
                 f"unknown average {average!r}; expected 'weighted', "
                 f"'macro', or 'micro'")
         if average == "macro" and num_classes is None \
-                and metric != "accuracy":
+                and metric not in ("accuracy", "auc"):
             raise ValueError(
                 "average='macro' needs an explicit num_classes (its "
                 "denominator is the class count; inferring it from "
@@ -102,7 +132,22 @@ class ClassificationEvaluator:
         self.num_classes = num_classes
 
     def evaluate(self, dataset: Dataset) -> float:
-        from distkeras_tpu.ops.metrics import precision_recall_f1
+        from distkeras_tpu.ops.metrics import (macro_auc_roc,
+                                               precision_recall_f1)
+
+        if self.metric == "auc":
+            scores = np.asarray(dataset[self.prediction_col])
+            if scores.ndim != 2 or scores.shape[-1] < 2:
+                raise ValueError(
+                    f"metric='auc' needs per-class scores [N, C] in "
+                    f"{self.prediction_col!r} (run ModelPredictor with "
+                    f"output='logits'), got shape {scores.shape}")
+            labels = _normalize_class_labels(
+                np.asarray(dataset[self.label_col]))
+            if scores.size == 0:
+                raise ValueError("cannot evaluate an empty dataset")
+            return float(macro_auc_roc(
+                scores, labels, num_classes=self.num_classes))
 
         pred, labels = _aligned_pred_labels(
             dataset, self.prediction_col, self.label_col)
@@ -126,17 +171,23 @@ class BinaryClassificationEvaluator:
 
     def __init__(self, metric: str = "auc",
                  prediction_col: str = "prediction",
-                 label_col: str = "label", threshold: float = 0.0):
+                 label_col: str = "label",
+                 threshold: float | None = None):
         """``threshold`` only affects ``metric='accuracy'``: scores
         above it classify as 1 (0.0 suits logits; use 0.5 for
-        probabilities).  AUC is threshold-free."""
+        probabilities).  When not given it defaults to 0.0 — but if
+        every score lies in [0, 1] (probability-shaped, where 0.0 would
+        classify everything as class 1 and silently return the base
+        rate), ``evaluate`` demands an explicit threshold instead of
+        guessing.  AUC is threshold-free."""
         if metric not in ("auc", "accuracy"):
             raise ValueError(f"unknown metric {metric!r}; expected "
                              f"'auc' or 'accuracy'")
         self.metric = metric
         self.prediction_col = prediction_col
         self.label_col = label_col
-        self.threshold = float(threshold)
+        self._threshold_given = threshold is not None
+        self.threshold = 0.0 if threshold is None else float(threshold)
 
     def evaluate(self, dataset: Dataset) -> float:
         from distkeras_tpu.ops.metrics import auc_roc, binary_accuracy
@@ -156,6 +207,14 @@ class BinaryClassificationEvaluator:
         if scores.size == 0:
             raise ValueError("cannot evaluate an empty dataset")
         if self.metric == "accuracy":
+            if not self._threshold_given and scores.min() >= 0.0 \
+                    and scores.max() <= 1.0:
+                raise ValueError(
+                    "all scores lie in [0, 1] (probability-shaped); "
+                    "the default threshold 0.0 would classify every "
+                    "row as class 1.  Pass threshold=0.5 for "
+                    "probabilities (or threshold=0.0 explicitly for "
+                    "logits that happen to land in [0, 1])")
             return float(binary_accuracy(scores - self.threshold,
                                          labels))
         return float(auc_roc(scores, labels))
@@ -190,10 +249,7 @@ def metrics_from_logits(logits, labels, *,
     logits = np.asarray(logits)
     labels = np.asarray(labels)
     if labels.ndim == logits.ndim:
-        if labels.shape[-1] > 1:
-            labels = np.argmax(labels, axis=-1)  # one-hot column
-        else:
-            labels = np.squeeze(labels, axis=-1)  # column vector of ids
+        labels = _normalize_class_labels(labels)
     if logits.shape[-1] == 1:
         return {"accuracy": float(M.binary_accuracy(logits, labels))}
     out = {"accuracy": float(M.accuracy(logits, labels))}

@@ -68,6 +68,57 @@ def auc_roc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
                      u / jnp.maximum(pos * neg, 1e-30), jnp.nan)
 
 
+def macro_auc_roc(scores: jnp.ndarray, labels: jnp.ndarray,
+                  num_classes: int | None = None) -> jnp.ndarray:
+    """One-vs-rest macro-averaged AUC-ROC for multi-class scores — the
+    ranking metric the binary configs get from ``auc_roc``, extended to
+    the multi-class baseline configs (SURVEY.md §5 metrics row).
+
+    ``scores`` is ``[N, C]`` (logits or probabilities — any per-class
+    monotone ranking); ``labels`` are integer class ids ``[N]``.  Each
+    class c scores ``auc_roc(scores[:, c], labels == c)``; the macro
+    average weights every class equally (the sklearn
+    ``roc_auc_score(..., multi_class='ovr', average='macro')``
+    convention).  On concrete inputs a class with no positive or no
+    negative rows raises (its one-vs-rest AUC is undefined); under jit
+    such a class contributes NaN, which poisons the mean rather than
+    silently shrinking the denominator."""
+    if scores.ndim != 2:
+        raise ValueError(
+            f"macro_auc_roc needs [N, C] per-class scores, got shape "
+            f"{scores.shape}")
+    n_cls = num_classes if num_classes is not None else scores.shape[-1]
+    if n_cls != scores.shape[-1]:
+        raise ValueError(
+            f"num_classes={n_cls} does not match score width "
+            f"{scores.shape[-1]}")
+    if n_cls < 2:
+        raise ValueError("macro_auc_roc needs at least 2 classes; use "
+                         "auc_roc for single-score binary rows")
+    labels = labels.reshape(-1)
+    if not isinstance(labels, jax.core.Tracer):
+        import numpy as np
+
+        l = np.asarray(labels).astype(np.int64)
+        if l.size and (l.min() < 0 or l.max() >= n_cls):
+            raise ValueError(
+                f"label ids out of range [0, {n_cls}): labels in "
+                f"[{l.min()}, {l.max()}] — pass num_classes (or widen "
+                f"the score matrix) to cover every class")
+        counts = np.bincount(l, minlength=n_cls)
+        missing = [c for c in range(n_cls)
+                   if counts[c] == 0 or counts[c] == labels.shape[0]]
+        if missing:
+            raise ValueError(
+                f"one-vs-rest AUC is undefined for classes {missing}: "
+                f"each class needs both positive and negative rows in "
+                f"the evaluated split")
+    per_class = [auc_roc(scores[:, c],
+                         (labels == c).astype(jnp.float32))
+                 for c in range(n_cls)]
+    return jnp.mean(jnp.stack(per_class))
+
+
 def confusion_matrix(pred: jnp.ndarray, labels: jnp.ndarray,
                      num_classes: int) -> jnp.ndarray:
     """``[C, C]`` counts, rows = true class, cols = predicted class.

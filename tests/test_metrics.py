@@ -126,7 +126,9 @@ def test_classification_evaluator_on_scored_dataset():
     mi = ClassificationEvaluator(metric="f1",
                                  average="micro").evaluate(ds)
     np.testing.assert_allclose(mi, acc, rtol=1e-6)
-    with pytest.raises(ValueError):
+    # 'auc' is one-vs-rest macro only; the default weighted average
+    # fails at construction
+    with pytest.raises(ValueError, match="macro"):
         ClassificationEvaluator(metric="auc")
 
 
@@ -228,6 +230,113 @@ def test_auc_and_macro_guards():
         ClassificationEvaluator(metric="f1").evaluate(
             Dataset({"prediction": np.zeros((0,)),
                      "label": np.zeros((0,))}))
+
+
+def test_macro_auc_matches_per_class_pairwise_reference():
+    from distkeras_tpu.ops.metrics import auc_roc, macro_auc_roc
+
+    rng = np.random.default_rng(1)
+    n, c = 120, 4
+    labels = rng.integers(0, c, size=n)
+    # scores correlated with the true class so AUCs are informative
+    scores = rng.normal(size=(n, c)) + 1.5 * np.eye(c)[labels]
+    expect = np.mean([_auc_pairwise(scores[:, k],
+                                    (labels == k).astype(np.int32))
+                      for k in range(c)])
+    np.testing.assert_allclose(float(macro_auc_roc(scores, labels)),
+                               expect, rtol=1e-6)
+    # consistency: binary [N,2] softmax-style scores, class-1 column ==
+    # the plain binary AUC (class-0 column is its mirror)
+    s2 = np.stack([-scores[:, 1], scores[:, 1]], axis=1)
+    l2 = (labels == 1).astype(np.int32)
+    np.testing.assert_allclose(
+        float(macro_auc_roc(s2, l2)),
+        float(auc_roc(s2[:, 1], l2)), rtol=1e-6)
+
+
+def test_macro_auc_guards():
+    from distkeras_tpu.ops.metrics import macro_auc_roc
+
+    with pytest.raises(ValueError, match=r"\[N, C\]"):
+        macro_auc_roc(np.zeros(8), np.zeros(8))
+    with pytest.raises(ValueError, match="does not match"):
+        macro_auc_roc(np.zeros((8, 3)), np.zeros(8), num_classes=5)
+    # a class absent from the split has undefined one-vs-rest AUC
+    with pytest.raises(ValueError, match="classes \\[2\\]"):
+        macro_auc_roc(np.zeros((4, 3)), np.array([0, 0, 1, 1]))
+    # label ids outside the score width raise, not silently rank as
+    # all-negative for every class
+    with pytest.raises(ValueError, match="out of range"):
+        macro_auc_roc(np.zeros((4, 3)), np.array([0, 1, 2, 3]))
+    with pytest.raises(ValueError, match="out of range"):
+        macro_auc_roc(np.zeros((4, 3)), np.array([0, 1, 2, -1]))
+
+
+def test_classification_evaluator_macro_auc():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import ClassificationEvaluator
+    from distkeras_tpu.ops.metrics import macro_auc_roc
+
+    rng = np.random.default_rng(2)
+    labels = rng.integers(0, 3, size=60)
+    logits = rng.normal(size=(60, 3)) + 2.0 * np.eye(3)[labels]
+    ds = Dataset({"prediction": logits, "label": labels})
+    ev = ClassificationEvaluator(metric="auc", average="macro")
+    np.testing.assert_allclose(
+        ev.evaluate(ds), float(macro_auc_roc(logits, labels)),
+        rtol=1e-6)
+    # one-hot labels work too (the OneHotTransformer workflow)
+    ds_oh = Dataset({"prediction": logits,
+                     "label": np.eye(3)[labels]})
+    np.testing.assert_allclose(ev.evaluate(ds_oh), ev.evaluate(ds),
+                               rtol=1e-6)
+    # class-id predictions (argmax'd already) can't be ranked
+    with pytest.raises(ValueError, match="per-class scores"):
+        ev.evaluate(Dataset({"prediction": labels, "label": labels}))
+
+
+def test_float_score_predictions_fail_loudly():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import (AccuracyEvaluator,
+                                          ClassificationEvaluator)
+
+    # a single-logit binary model's float scores must not be compared
+    # raw against class ids (would silently return ~0 accuracy)
+    ds = Dataset({"prediction": np.array([[0.9], [-1.2], [0.3]]),
+                  "label": np.array([1, 0, 1])})
+    with pytest.raises(ValueError, match="BinaryClassification"):
+        AccuracyEvaluator().evaluate(ds)
+    with pytest.raises(ValueError, match="BinaryClassification"):
+        ClassificationEvaluator(metric="f1").evaluate(ds)
+    # integral float class ids (e.g. argmax cast to float) still work
+    ds_ok = Dataset({"prediction": np.array([1.0, 0.0, 1.0]),
+                     "label": np.array([1, 0, 1])})
+    assert AccuracyEvaluator().evaluate(ds_ok) == 1.0
+
+
+def test_binary_accuracy_demands_threshold_for_probabilities():
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import BinaryClassificationEvaluator
+
+    probs = np.array([0.1, 0.4, 0.6, 0.9])
+    labels = np.array([0, 0, 1, 1])
+    ds = Dataset({"prediction": probs, "label": labels})
+    # default threshold 0.0 on probability-shaped scores would score
+    # everything class 1 -> demand an explicit threshold
+    with pytest.raises(ValueError, match="threshold"):
+        BinaryClassificationEvaluator(metric="accuracy").evaluate(ds)
+    acc = BinaryClassificationEvaluator(
+        metric="accuracy", threshold=0.5).evaluate(ds)
+    assert acc == 1.0
+    # an explicit 0.0 is honored without complaint
+    acc0 = BinaryClassificationEvaluator(
+        metric="accuracy", threshold=0.0).evaluate(ds)
+    assert acc0 == 0.5
+    # logit-shaped scores (outside [0,1]) keep the 0.0 default
+    ds_logit = Dataset({"prediction": np.array([-2.0, -0.5, 0.7, 1.5]),
+                        "label": labels})
+    assert BinaryClassificationEvaluator(
+        metric="accuracy").evaluate(ds_logit) == 1.0
 
 
 def test_binary_evaluator_rejects_empty():
