@@ -152,6 +152,82 @@ class BasicBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class FusedBottleneckBlock(nn.Module):
+    """Bottleneck block lowered through the block-granular Pallas
+    kernels (``ops/fused_block.py``): the first 1x1 conv + GN + ReLU is
+    one kernel; the 3x3 conv's GN, second 1x1 conv, its GN, residual
+    add, and final ReLU are a second kernel; the downsample projection
+    (conv1x1 + GN) is a third.  Only the 3x3 conv itself stays with
+    XLA.  Same math as ``BottleneckBlock`` with ``norm='group'`` —
+    parity-tested in ``tests/test_fused_block.py`` — but each
+    activation tensor crosses HBM once per direction instead of
+    3-4 times (PERF.md §11).
+
+    Parameter tree is flat (``conv1``/``gn1_scale``/...), not the
+    nested flax-module layout — fused and unfused checkpoints are not
+    interchangeable.
+    """
+
+    filters: int
+    strides: tuple[int, int]
+    dtype: Any
+    fuse_op1: bool = True  # False: op1/downsample stay XLA, tail fused
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.filters
+        cin = x.shape[-1]
+        cout = 4 * w
+        g_mid = math.gcd(32, w)
+        g_out = math.gcd(32, cout)
+        from distkeras_tpu.ops.fused_block import (fused_bottleneck_tail,
+                                                   fused_conv1x1_gn)
+
+        init = nn.initializers.lecun_normal()
+        ones = nn.initializers.ones_init()
+        zeros = nn.initializers.zeros_init()
+        if self.fuse_op1:
+            k1 = self.param("conv1", init, (cin, w), jnp.float32)
+            y = fused_conv1x1_gn(
+                x, k1.astype(self.dtype),
+                self.param("gn1_scale", ones, (w,), jnp.float32),
+                self.param("gn1_bias", zeros, (w,), jnp.float32),
+                groups=g_mid, relu=True)
+        else:
+            y = nn.Conv(w, (1, 1), use_bias=False, dtype=self.dtype,
+                        name="conv1u")(x)
+            y = AdaptiveGroupNorm(dtype=self.dtype, relu=True,
+                                  name="gn1u")(y)
+        y = nn.Conv(w, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        if cin != cout or self.strides != (1, 1):
+            if self.fuse_op1:
+                xs = x[:, ::self.strides[0], ::self.strides[1], :]
+                kd = self.param("convd", init, (cin, cout), jnp.float32)
+                residual = fused_conv1x1_gn(
+                    xs, kd.astype(self.dtype),
+                    self.param("gnd_scale", ones, (cout,), jnp.float32),
+                    self.param("gnd_bias", zeros, (cout,), jnp.float32),
+                    groups=g_out, relu=False)
+            else:
+                residual = nn.Conv(cout, (1, 1), self.strides,
+                                   use_bias=False, dtype=self.dtype,
+                                   name="convdu")(x)
+                residual = AdaptiveGroupNorm(dtype=self.dtype,
+                                             name="gndu")(residual)
+        else:
+            residual = x
+        k3 = self.param("conv3", init, (w, cout), jnp.float32)
+        # zero-init the last norm's scale so blocks start as identity
+        return fused_bottleneck_tail(
+            y, k3.astype(self.dtype),
+            self.param("gn2_scale", ones, (w,), jnp.float32),
+            self.param("gn2_bias", zeros, (w,), jnp.float32),
+            self.param("gn3_scale", zeros, (cout,), jnp.float32),
+            self.param("gn3_bias", zeros, (cout,), jnp.float32),
+            residual, groups2=g_mid, groups3=g_out)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: tuple[int, int]
@@ -190,11 +266,24 @@ class ResNet(nn.Module):
     norm: str = "group"
     dtype: str = "bfloat16"
     stem: str = "conv"  # 'conv' | 'space_to_depth'
+    #: 'none' | 'pallas_block' (op1+tail+downsample kernels) |
+    #: 'pallas_tail' (tail kernel only; op1/downsample stay XLA)
+    fusion: str = "none"
+    #: stages (0-based) the fusion applies to; None = all stages.
+    fusion_stages: Sequence[int] | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         dtype = jnp.dtype(self.dtype)
         norm = _norm(self.norm, dtype, train)
+        if self.fusion in ("pallas_block", "pallas_tail"):
+            if not self.bottleneck or self.norm != "group":
+                raise ValueError(
+                    f"fusion={self.fusion!r} implements the GroupNorm "
+                    f"bottleneck block only (norm='group', "
+                    f"bottleneck=True)")
+        elif self.fusion != "none":
+            raise ValueError(f"unknown fusion {self.fusion!r}")
         block = BottleneckBlock if self.bottleneck else BasicBlock
 
         x = x.astype(dtype)
@@ -220,10 +309,19 @@ class ResNet(nn.Module):
         x = norm(relu=True)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, size in enumerate(self.stage_sizes):
+            fuse_here = self.fusion != "none" and (
+                self.fusion_stages is None
+                or stage in self.fusion_stages)
             for i in range(size):
                 strides = (2, 2) if stage > 0 and i == 0 else (1, 1)
-                x = block(filters=self.width * 2 ** stage, strides=strides,
-                          norm=norm, dtype=dtype)(x)
+                if fuse_here:
+                    x = FusedBottleneckBlock(
+                        filters=self.width * 2 ** stage,
+                        strides=strides, dtype=dtype,
+                        fuse_op1=self.fusion == "pallas_block")(x)
+                else:
+                    x = block(filters=self.width * 2 ** stage,
+                              strides=strides, norm=norm, dtype=dtype)(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 
