@@ -8,6 +8,7 @@ vectorized, static-shape, host-side — feeding device-sharded batches
 """
 
 from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.sharded import ShardedDataset  # noqa: F401
 from distkeras_tpu.data.transformers import (  # noqa: F401
     AssembleTransformer,
     DenseTransformer,

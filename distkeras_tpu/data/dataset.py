@@ -119,6 +119,22 @@ class Dataset:
             return cls({k: np.asarray(archive[k])
                         for k in archive.files})
 
+    @classmethod
+    def from_npz_shards(cls, pattern_or_paths):
+        """Out-of-core dataset over many ``.npz`` shard files (glob
+        pattern or path list) — returns a ``ShardedDataset`` that
+        trainers stream one shard at a time (``data/sharded.py``)."""
+        from distkeras_tpu.data.sharded import from_npz_shards
+
+        return from_npz_shards(pattern_or_paths)
+
+    def to_npz_shards(self, prefix, rows_per_shard: int) -> list[str]:
+        """Write this dataset as ``.npz`` shard files readable by
+        ``from_npz_shards``; returns the paths."""
+        from distkeras_tpu.data.sharded import to_npz_shards
+
+        return to_npz_shards(self, prefix, rows_per_shard)
+
     def to_npz(self, path) -> str:
         """Write all columns to an ``.npz`` archive (the format the
         examples' ``--data-npz`` flag reads).  Returns the actual file

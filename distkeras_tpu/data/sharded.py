@@ -1,0 +1,173 @@
+"""Out-of-core training data: a dataset backed by ``.npz`` shard files.
+
+The reference's substrate (Spark DataFrames, SURVEY.md §1 L0) scaled
+past host RAM by construction — partitions lived on the cluster and
+flowed through executors.  The rebuild's equivalent is file-granular:
+``ShardedDataset`` holds a *list of shard files* plus their row counts
+(read from the npy headers, not the data), and materializes one shard
+at a time.  Trainers iterate ``epoch_segments`` — shard files in a
+seed-permuted order, rows shuffled within each shard ("shuffle what
+fits", the standard out-of-core approximation of a global shuffle) —
+so peak memory is one shard, not the dataset.
+
+With a single shard file the epoch is bit-identical to the in-memory
+path (same ``Dataset.shuffle(seed)`` permutation), which is the
+equivalence contract ``tests/test_sharded_data.py`` pins.
+
+Multi-host: every process sees the same deterministic segment order and
+slices rows per process inside the trainer (``mesh.process_shard`` /
+worker repartition), exactly as the in-memory path does.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import zipfile
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def _npz_column_meta(path: str) -> dict[str, tuple[tuple, np.dtype]]:
+    """Column name -> (shape, dtype) from an npz's member headers —
+    reads a few hundred bytes per member, never the array data."""
+    from numpy.lib import format as npf
+
+    meta = {}
+    with zipfile.ZipFile(path) as z:
+        for name in z.namelist():
+            if not name.endswith(".npy"):
+                continue
+            with z.open(name) as fh:
+                version = npf.read_magic(fh)
+                if version == (1, 0):
+                    shape, _, dtype = npf.read_array_header_1_0(fh)
+                else:
+                    shape, _, dtype = npf.read_array_header_2_0(fh)
+            meta[name[:-4]] = (shape, dtype)
+    return meta
+
+
+class ShardedDataset:
+    """A list of ``.npz`` shard files acting as one logical dataset.
+
+    Construct via ``Dataset.from_npz_shards(pattern)`` or directly from
+    paths.  Header metadata (row counts, columns, dtypes) is read
+    eagerly and validated for consistency; array data is loaded one
+    shard at a time by ``load_shard`` / ``epoch_segments``.
+    """
+
+    def __init__(self, paths: Sequence[str]):
+        paths = [str(p) for p in paths]
+        if not paths:
+            raise ValueError("ShardedDataset needs at least one shard")
+        self.paths = paths
+        metas = [_npz_column_meta(p) for p in paths]
+        names = set(metas[0])
+        for p, m in zip(paths[1:], metas[1:]):
+            if set(m) != names:
+                raise ValueError(
+                    f"shard {p} has columns {sorted(m)}, expected "
+                    f"{sorted(names)} (from {paths[0]})")
+            for k in names:
+                if m[k][0][1:] != metas[0][k][0][1:]:
+                    raise ValueError(
+                        f"shard {p} column {k!r} has row shape "
+                        f"{m[k][0][1:]}, expected {metas[0][k][0][1:]}")
+        self._column_names = sorted(names)
+        self.shard_rows = []
+        for p, m in zip(paths, metas):
+            counts = {v[0][0] for v in m.values()}
+            if len(counts) != 1:
+                raise ValueError(
+                    f"shard {p}: column lengths differ: "
+                    f"{ {k: v[0][0] for k, v in m.items()} }")
+            self.shard_rows.append(counts.pop())
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self._column_names)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.paths)
+
+    def __len__(self) -> int:
+        return int(sum(self.shard_rows))
+
+    def __repr__(self) -> str:
+        return (f"ShardedDataset(shards={self.num_shards}, "
+                f"rows={len(self)}, columns={self._column_names})")
+
+    # -- materialization ---------------------------------------------------
+
+    def load_shard(self, index: int) -> Dataset:
+        return Dataset.from_npz(self.paths[index])
+
+    def to_dataset(self) -> Dataset:
+        """Materialize everything (small sets / tests only)."""
+        out = self.load_shard(0)
+        for i in range(1, self.num_shards):
+            out = out.concat(self.load_shard(i))
+        return out
+
+    def epoch_segment_loaders(self, seed: int = 0):
+        """The epoch plan without the data: yields ``(rows, load)``
+        pairs in the seed-permuted shard order, where ``rows`` comes
+        from the header metadata and ``load()`` materializes that
+        segment (shuffled).  Lets a resuming trainer skip whole shard
+        files it has already consumed without reading them."""
+        rng = np.random.default_rng(seed)
+        order = (rng.permutation(self.num_shards)
+                 if self.num_shards > 1 else [0])
+        for slot, i in enumerate(order):
+            # per-shard salt keeps distinct shards from sharing a
+            # permutation; shard count 1 must keep the plain seed for
+            # the bit-identity contract
+            salt = 0 if self.num_shards == 1 else 1000003 * (slot + 1) + i
+            yield (int(self.shard_rows[int(i)]),
+                   lambda idx=int(i), s=seed + salt:
+                   self.load_shard(idx).shuffle(seed=s))
+
+    def epoch_segments(self, seed: int = 0) -> Iterator[Dataset]:
+        """One training epoch as a stream of in-memory ``Dataset``
+        segments: shard files in a seed-permuted order, rows shuffled
+        within each shard.  Deterministic in ``seed``; with one shard
+        this is exactly ``[full.shuffle(seed)]`` (the in-memory
+        trainers' epoch), so single-shard training is bit-identical to
+        in-memory training."""
+        for _, load in self.epoch_segment_loaders(seed):
+            yield load()
+
+
+def from_npz_shards(pattern_or_paths) -> ShardedDataset:
+    """``Dataset.from_npz_shards``: build a ShardedDataset from a glob
+    pattern (sorted) or an explicit path list."""
+    if isinstance(pattern_or_paths, (list, tuple)):
+        return ShardedDataset(pattern_or_paths)
+    paths = sorted(_glob.glob(str(pattern_or_paths)))
+    if not paths:
+        raise ValueError(
+            f"no files match {pattern_or_paths!r}")
+    return ShardedDataset(paths)
+
+
+def to_npz_shards(dataset: Dataset, prefix: str,
+                  rows_per_shard: int) -> list[str]:
+    """Split ``dataset`` into ``.npz`` shard files
+    ``{prefix}-00000.npz, ...``; returns the paths (the writer side of
+    ``from_npz_shards``, used by tests/examples)."""
+    if rows_per_shard < 1:
+        raise ValueError(f"rows_per_shard must be >= 1, got "
+                         f"{rows_per_shard}")
+    n = len(dataset)
+    paths = []
+    for idx, start in enumerate(range(0, n, rows_per_shard)):
+        part = Dataset({k: v[start:start + rows_per_shard]
+                        for k, v in dataset.columns.items()})
+        paths.append(part.to_npz(f"{prefix}-{idx:05d}.npz"))
+    return paths

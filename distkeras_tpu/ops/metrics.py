@@ -113,10 +113,12 @@ def macro_auc_roc(scores: jnp.ndarray, labels: jnp.ndarray,
                 f"one-vs-rest AUC is undefined for classes {missing}: "
                 f"each class needs both positive and negative rows in "
                 f"the evaluated split")
-    per_class = [auc_roc(scores[:, c],
-                         (labels == c).astype(jnp.float32))
-                 for c in range(n_cls)]
-    return jnp.mean(jnp.stack(per_class))
+    # one vectorized rank computation over all classes (a Python loop
+    # would dispatch C sorts and unroll C copies under jit)
+    masks = (labels[None, :] == jnp.arange(n_cls)[:, None]).astype(
+        jnp.float32)                                     # [C, N]
+    per_class = jax.vmap(auc_roc, in_axes=(1, 0))(scores, masks)
+    return jnp.mean(per_class)
 
 
 def confusion_matrix(pred: jnp.ndarray, labels: jnp.ndarray,

@@ -32,7 +32,7 @@ from typing import Any, ClassVar, Mapping, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import mesh as mesh_lib
 from distkeras_tpu.data.dataset import Dataset
@@ -78,6 +78,35 @@ def _stack_batches(shard: Dataset, batch_size: int,
         col = shard[c][:n * batch_size]
         out[c] = col.reshape((n, batch_size) + col.shape[1:])
     return out
+
+
+def _epoch_segments(dataset, seed: int):
+    """One epoch as in-memory ``Dataset`` segments.
+
+    In-memory datasets yield exactly one segment — the whole set,
+    shuffled — so existing behavior is bit-identical.  A
+    ``ShardedDataset`` (``data/sharded.py``) streams its shard files in
+    seed-permuted order with rows shuffled per shard, so host peak
+    memory is one shard, not the dataset (the out-of-core path; Spark's
+    partition streaming was the reference's equivalent, SURVEY.md §1
+    L0)."""
+    from distkeras_tpu.data.sharded import ShardedDataset
+
+    if isinstance(dataset, ShardedDataset):
+        return dataset.epoch_segments(seed)
+    return iter([dataset.shuffle(seed=seed)])
+
+
+def _epoch_segment_loaders(dataset, seed: int):
+    """``_epoch_segments`` with the data deferred: yields ``(rows,
+    load)`` so a resuming PS trainer can skip whole already-consumed
+    shard files from header metadata alone."""
+    from distkeras_tpu.data.sharded import ShardedDataset
+
+    if isinstance(dataset, ShardedDataset):
+        return dataset.epoch_segment_loaders(seed)
+    return iter([(len(dataset),
+                  lambda: dataset.shuffle(seed=seed))])
 
 
 class Trainer:
@@ -246,18 +275,23 @@ class SingleTrainer(Trainer):
         run_chunk = jax.jit(make_window_runner(step))
 
         for epoch in range(start_epoch, self.num_epoch):
-            shard = dataset.shuffle(seed=self.seed + epoch)
-            stacked = _stack_batches(shard, self.batch_size,
-                                     self._columns())
-            if stacked is None:
-                raise ValueError("dataset smaller than one batch")
-            n = len(next(iter(stacked.values())))
             losses = []
-            for lo in range(0, n, self.SCAN_CHUNK):
-                chunk = {k: jnp.asarray(v[lo:lo + self.SCAN_CHUNK])
-                         for k, v in stacked.items()}
-                state, metrics = run_chunk(state, chunk)
-                losses.append(np.asarray(metrics["loss"]))
+            for segment in _epoch_segments(dataset, self.seed + epoch):
+                stacked = _stack_batches(segment, self.batch_size,
+                                         self._columns())
+                if stacked is None:
+                    # a shard file smaller than one batch: dropped like
+                    # any other tail remainder (never silently for the
+                    # whole epoch — see the check below)
+                    continue
+                n = len(next(iter(stacked.values())))
+                for lo in range(0, n, self.SCAN_CHUNK):
+                    chunk = {k: jnp.asarray(v[lo:lo + self.SCAN_CHUNK])
+                             for k, v in stacked.items()}
+                    state, metrics = run_chunk(state, chunk)
+                    losses.append(np.asarray(metrics["loss"]))
+            if not losses:
+                raise ValueError("dataset smaller than one batch")
             epoch_loss = float(np.concatenate(losses).mean())
             self._record(epoch_loss=epoch_loss)
             self._eval_epoch(state.variables())
@@ -276,13 +310,24 @@ class SyncTrainer(Trainer):
     SCAN_CHUNK = 32
 
     def __init__(self, model, num_workers: int | None = None,
-                 model_parallel: int = 1, tp_rules=None, **kwargs):
+                 model_parallel: int = 1, tp_rules=None,
+                 pipeline_stages: int = 1,
+                 pipeline_microbatches: int | None = None, **kwargs):
         """``model_parallel`` > 1 adds a tensor-parallel dimension: the
         mesh becomes ``(workers, model)`` and parameters are sharded
         over the ``model`` axis per ``parallel.tensor_parallel`` rules
         (Megatron-style for ``transformer_lm``/``mlp``; pass
         ``tp_rules`` for custom models).  Pure GSPMD — same numerics as
-        ``model_parallel=1``, XLA inserts the collectives."""
+        ``model_parallel=1``, XLA inserts the collectives.
+
+        ``pipeline_stages`` > 1 instead runs dp x pp over a
+        ``(workers, stage)`` mesh: the model must be a
+        ``transformer_lm`` whose ``num_layers`` divides into the stage
+        count — its layer stack (``scan_blocks`` form) is sharded one
+        slice per stage and driven through the GPipe microbatch
+        schedule (``parallel.pipeline``).  ``pipeline_microbatches``
+        defaults to 2 x stages (bubble fraction (S-1)/(M+S-1)).
+        Mutually exclusive with ``model_parallel``."""
         super().__init__(model, **kwargs)
         self.num_workers = num_workers
         self.model_parallel = int(model_parallel)
@@ -290,8 +335,128 @@ class SyncTrainer(Trainer):
             raise ValueError(
                 f"model_parallel must be >= 1, got {model_parallel}")
         self.tp_rules = tp_rules
+        self.pipeline_stages = int(pipeline_stages)
+        if self.pipeline_stages < 1:
+            raise ValueError(
+                f"pipeline_stages must be >= 1, got {pipeline_stages}")
+        if self.pipeline_stages > 1 and self.model_parallel > 1:
+            raise ValueError(
+                "pipeline_stages and model_parallel are mutually "
+                "exclusive (pp x tp composition is not implemented)")
+        self.pipeline_microbatches = (
+            None if pipeline_microbatches is None
+            else int(pipeline_microbatches))
 
     def _train(self, dataset, initial_variables, resume_from=None):
+        if self.pipeline_stages > 1:
+            return self._train_pipeline(dataset, initial_variables,
+                                        resume_from)
+        return self._train_dp(dataset, initial_variables, resume_from)
+
+    def _train_pipeline(self, dataset, initial_variables, resume_from):
+        """dp x pp: see ``parallel.pipeline.make_pp_train_step``."""
+        from distkeras_tpu.models.core import ModelSpec
+        from distkeras_tpu.parallel import pipeline as pp
+        from distkeras_tpu.ops.losses import resolve_loss
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "pipeline_stages > 1 is single-process for now (the "
+                "stage axis must not cross hosts anyway; use more "
+                "workers per host)")
+        stages = self.pipeline_stages
+        if self.spec.family != "transformer_lm":
+            raise ValueError(
+                f"pipeline_stages > 1 supports the transformer_lm "
+                f"family (homogeneous blocks), got "
+                f"{self.spec.family!r}")
+        kwargs = dict(self.spec.kwargs)
+        if kwargs.get("num_experts"):
+            raise ValueError(
+                "pipeline_stages > 1 supports the dense-FFN "
+                "transformer (MoE blocks are not homogeneous across "
+                "the stack's expert dispatch)")
+        n_layers = kwargs.get("num_layers", 4)
+        if n_layers % stages:
+            raise ValueError(
+                f"num_layers={n_layers} does not divide into "
+                f"{stages} stages")
+        kwargs["scan_blocks"] = True
+        spec = ModelSpec(family="transformer_lm", kwargs=kwargs,
+                         input_shape=self.spec.input_shape,
+                         input_dtype=self.spec.input_dtype)
+        model = spec.build()
+
+        devices = jax.devices()
+        num_workers = self.num_workers or max(
+            1, len(devices) // stages)
+        if num_workers * stages > len(devices):
+            raise ValueError(
+                f"pipeline_stages={stages} with {num_workers} workers "
+                f"needs {num_workers * stages} devices, have "
+                f"{len(devices)}")
+        mesh = Mesh(
+            np.asarray(devices[:num_workers * stages]).reshape(
+                num_workers, stages),
+            (mesh_lib.WORKER_AXIS, pp.STAGE_AXIS))
+        microbatches = self.pipeline_microbatches or 2 * stages
+        if self.batch_size % microbatches:
+            raise ValueError(
+                f"per-worker batch {self.batch_size} not divisible "
+                f"into {microbatches} microbatches")
+
+        tx = self._tx()
+        if initial_variables is not None:
+            variables = dict(initial_variables)
+        else:
+            sample = jnp.asarray(spec.example_input(self.batch_size))
+            variables = model.init(jax.random.key(self.seed), sample)
+        state = TrainState.create(variables, tx,
+                                  jax.random.key(self.seed + 1))
+        state, cursor = self._maybe_resume(resume_from, state)
+        specs = pp.lm_state_specs(state)
+        state_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        state = jax.device_put(state, state_shardings)
+        step = pp.make_pp_train_step(
+            model, resolve_loss(self.loss), tx, mesh,
+            num_microbatches=microbatches,
+            workers_axis=mesh_lib.WORKER_AXIS,
+            features_col=self.features_col, label_col=self.label_col)
+        run_chunk = jax.jit(make_window_runner(step))
+
+        global_batch = self.batch_size * num_workers
+        batch_sharded = NamedSharding(
+            mesh, P(None, mesh_lib.WORKER_AXIS))
+        start_epoch = int(cursor.get("epoch", 0))
+        self.num_workers = num_workers
+        for epoch in range(start_epoch, self.num_epoch):
+            pending = []
+            for segment in _epoch_segments(dataset, self.seed + epoch):
+                stacked = _stack_batches(segment, global_batch,
+                                         self._columns())
+                if stacked is None:
+                    continue
+                n = len(next(iter(stacked.values())))
+                for lo in range(0, n, self.SCAN_CHUNK):
+                    local = {k: v[lo:lo + self.SCAN_CHUNK]
+                             for k, v in stacked.items()}
+                    chunk = jax.device_put(local, batch_sharded)
+                    state, metrics = run_chunk(state, chunk)
+                    pending.append(metrics["loss"])
+            if not pending:
+                raise ValueError(
+                    f"dataset smaller than one global batch "
+                    f"({global_batch})")
+            losses = [mesh_lib.fetch(x) for x in pending]
+            self._record(epoch_loss=float(np.concatenate(losses).mean()))
+            self._eval_epoch(state.variables())
+            self._maybe_save(state, {"epoch": epoch + 1})
+        self.trained_variables = state.variables()
+        return self.trained_variables
+
+    def _train_dp(self, dataset, initial_variables, resume_from=None):
         devices = jax.devices()
         mp = self.model_parallel
         num_workers = self.num_workers or max(1, len(devices) // mp)
@@ -369,27 +534,34 @@ class SyncTrainer(Trainer):
         start_epoch = int(cursor.get("epoch", 0))
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
-            shard = mesh_lib.process_shard(
-                dataset.shuffle(seed=self.seed + epoch))
-            stacked = _stack_batches(shard, local_batch, self._columns())
-            if stacked is None:
+            pending = []
+            for segment in _epoch_segments(dataset, self.seed + epoch):
+                shard = mesh_lib.process_shard(segment)
+                stacked = _stack_batches(shard, local_batch,
+                                         self._columns())
+                if stacked is None:
+                    # shard file smaller than one global batch: tail
+                    # remainder; the epoch-level emptiness check below
+                    # keeps it from passing silently
+                    continue
+                n = len(next(iter(stacked.values())))
+                for lo in range(0, n, self.SCAN_CHUNK):
+                    local = {k: v[lo:lo + self.SCAN_CHUNK]
+                             for k, v in stacked.items()}
+                    if use_mesh:
+                        chunk = mesh_lib.global_batch_from_local(
+                            batch_sharded, local)
+                    else:
+                        chunk = {k: jnp.asarray(v)
+                                 for k, v in local.items()}
+                    state, metrics = run_chunk(state, chunk)
+                    # keep the device handle; fetching here would block
+                    # next chunk's host assembly behind device compute
+                    pending.append(metrics["loss"])
+            if not pending:
                 raise ValueError(
                     f"dataset smaller than one global batch "
                     f"({global_batch})")
-            n = len(next(iter(stacked.values())))
-            pending = []
-            for lo in range(0, n, self.SCAN_CHUNK):
-                local = {k: v[lo:lo + self.SCAN_CHUNK]
-                         for k, v in stacked.items()}
-                if use_mesh:
-                    chunk = mesh_lib.global_batch_from_local(
-                        batch_sharded, local)
-                else:
-                    chunk = {k: jnp.asarray(v) for k, v in local.items()}
-                state, metrics = run_chunk(state, chunk)
-                # keep the device handle; fetching here would block
-                # next chunk's host assembly behind device compute
-                pending.append(metrics["loss"])
             losses = [mesh_lib.fetch(x) for x in pending]
             self._record(epoch_loss=float(np.concatenate(losses).mean()))
             self._eval_epoch(state.variables())
@@ -665,23 +837,6 @@ class DistributedTrainer(Trainer):
                      "perm_key": perm_key}, point)
 
         for epoch in range(start_epoch, self.num_epoch):
-            shard_all = dataset.shuffle(seed=self.seed + 17 * epoch)
-            shards = shard_all.repartition(num_workers)
-            # Multi-host: stack only this process's workers' shards (the
-            # dataset generation is deterministic, so every process sees
-            # the same global rows and takes a disjoint slice).
-            per_worker = [
-                _stack_batches(shards[i], rows_per_worker_batch, cols)
-                for i in local_workers]
-            if any(p is None for p in per_worker):
-                raise ValueError("a worker shard is smaller than one batch")
-            n_batches = min(len(next(iter(p.values())))
-                            for p in per_worker)
-            n_rounds = n_batches // window
-            if n_rounds == 0:
-                raise ValueError(
-                    f"not enough batches per worker ({n_batches}) for one "
-                    f"communication window ({window})")
             resuming_mid_epoch = epoch == start_epoch and start_round > 0
             if resuming_mid_epoch:
                 # this epoch's pre-kill rounds live in the restored
@@ -691,12 +846,6 @@ class DistributedTrainer(Trainer):
                 epoch_losses = list(
                     self.history.get("round_loss", [])[-start_round:])
             else:
-                # Tail batches that don't fill a whole window are
-                # dropped (the reference's per-partition loop had the
-                # same remainder behavior); record the count so it is
-                # never silent.
-                self._record(
-                    dropped_tail_batches=n_batches - n_rounds * window)
                 epoch_losses = []
             first_round = start_round if epoch == start_epoch else 0
 
@@ -717,35 +866,123 @@ class DistributedTrainer(Trainer):
                     staleness=mesh_lib.fetch(
                         metrics_dev["staleness"]).tolist())
 
-            for r in range(first_round, n_rounds):
-                perm_key, sub = jax.random.split(perm_key)
-                perm = jax.random.permutation(sub, num_workers)
-                # [W, window, B, ...] device batch for this round; note
-                # the full epoch is already stacked per worker on the
-                # host (per_worker above) — host peak is one epoch, the
-                # device sees one round at a time.
-                batch = {
-                    k: np.stack(
-                        [p[k][r * window:(r + 1) * window]
-                         for p in per_worker])
-                    for k in cols}
-                if placement.mesh is not None:
-                    batch = mesh_lib.global_batch_from_local(row, batch)
-                    perm = mesh_lib.global_batch_from_local(
-                        rep, np.asarray(perm))
-                else:
-                    batch = {k: jnp.asarray(v)
-                             for k, v in batch.items()}
-                ps_state, worker_states, metrics = round_jit(
-                    ps_state, worker_states, batch, perm)
-                if pending is not None:
-                    drain(pending)
-                pending = metrics
-                every = self.checkpoint_every_rounds
-                if every and (r + 1) % every == 0 and r + 1 < n_rounds:
+            # Rounds are numbered globally across segments (one segment
+            # for in-memory datasets — identical behavior; one per
+            # shard file for ShardedDataset) so the checkpoint cursor's
+            # "round" stays meaningful out-of-core.
+            round_base = 0
+            # a mid-epoch save due exactly at a segment boundary is
+            # deferred until the next segment proves the epoch goes on
+            # (the epoch-end save supersedes it otherwise) — keeps the
+            # in-memory path save-for-save identical while still
+            # honoring checkpoint_every_rounds across segments
+            due_save = None
+            def predicted_rounds(rows: int) -> int:
+                # mirrors repartition + _stack_batches + // window
+                # exactly, from row counts alone
+                if rows < num_workers:
+                    return 0
+                return ((rows // num_workers)
+                        // rows_per_worker_batch) // window
+
+            for seg_rows, load_segment in _epoch_segment_loaders(
+                    dataset, self.seed + 17 * epoch):
+                sr_hint = predicted_rounds(seg_rows)
+                if round_base + sr_hint <= first_round and sr_hint > 0:
+                    # resume fast-path: every round of this segment
+                    # predates the resume point — skip the file read
+                    # entirely (records suppressed below anyway)
+                    round_base += sr_hint
+                    continue
+                # records are suppressed for segments already processed
+                # before a mid-epoch kill (their records live in the
+                # restored history): a segment was entered pre-kill iff
+                # its first round predates the resume round
+                record_this_segment = round_base >= first_round
+                if seg_rows < num_workers:
+                    # too few rows to give every worker one: the whole
+                    # segment is dropped — never silently, and without
+                    # reading the file (row count is header metadata)
+                    if record_this_segment:
+                        self._record(skipped_segment_rows=seg_rows)
+                    continue
+                segment = load_segment()
+                shards = segment.repartition(num_workers)
+                # Multi-host: stack only this process's workers' shards
+                # (segment order is seed-deterministic, so every process
+                # sees the same global rows and takes a disjoint slice).
+                per_worker = [
+                    _stack_batches(shards[i], rows_per_worker_batch,
+                                   cols)
+                    for i in local_workers]
+                if any(p is None for p in per_worker):
+                    if record_this_segment:
+                        self._record(skipped_segment_rows=seg_rows)
+                    continue  # segment smaller than one batch/worker
+                n_batches = min(len(next(iter(p.values())))
+                                for p in per_worker)
+                seg_rounds = n_batches // window
+                if record_this_segment:
+                    # Tail batches that don't fill a whole window are
+                    # dropped (the reference's per-partition loop had
+                    # the same remainder behavior); record the count so
+                    # it is never silent.
+                    self._record(
+                        dropped_tail_batches=(n_batches
+                                              - seg_rounds * window))
+                if due_save is not None and seg_rounds > 0:
                     drain(pending)
                     pending = None
-                    save_point({"epoch": epoch, "round": r + 1})
+                    save_point({"epoch": epoch, "round": due_save})
+                    due_save = None
+                for r_local in range(seg_rounds):
+                    r = round_base + r_local
+                    if r < first_round:
+                        continue  # resume: rounds already in the ckpt
+                    perm_key, sub = jax.random.split(perm_key)
+                    perm = jax.random.permutation(sub, num_workers)
+                    # [W, window, B, ...] device batch for this round;
+                    # note the whole segment is already stacked per
+                    # worker on the host (per_worker above) — host peak
+                    # is one segment, the device sees one round at a
+                    # time.
+                    batch = {
+                        k: np.stack(
+                            [p[k][r_local * window:
+                                  (r_local + 1) * window]
+                             for p in per_worker])
+                        for k in cols}
+                    if placement.mesh is not None:
+                        batch = mesh_lib.global_batch_from_local(row,
+                                                                 batch)
+                        perm = mesh_lib.global_batch_from_local(
+                            rep, np.asarray(perm))
+                    else:
+                        batch = {k: jnp.asarray(v)
+                                 for k, v in batch.items()}
+                    ps_state, worker_states, metrics = round_jit(
+                        ps_state, worker_states, batch, perm)
+                    if pending is not None:
+                        drain(pending)
+                    pending = metrics
+                    every = self.checkpoint_every_rounds
+                    if every and (r + 1) % every == 0:
+                        if r_local + 1 < seg_rounds:
+                            drain(pending)
+                            pending = None
+                            save_point({"epoch": epoch,
+                                        "round": r + 1})
+                        else:
+                            # due exactly at the segment boundary:
+                            # defer — flushed when the next segment
+                            # proves the epoch continues, superseded
+                            # by the epoch-end save otherwise
+                            due_save = r + 1
+                round_base += seg_rounds
+            if round_base == 0:
+                raise ValueError(
+                    f"not enough batches per worker for one "
+                    f"communication window ({window}) in any segment")
             if pending is not None:
                 drain(pending)
             self._record(epoch_loss=float(np.mean(epoch_losses)))
@@ -779,6 +1016,7 @@ class DistributedTrainer(Trainer):
         reduced so every process returns identical results."""
         import threading
 
+        from distkeras_tpu.data.sharded import ShardedDataset
         from distkeras_tpu.parallel.compression import (raw_nbytes,
                                                         resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
@@ -786,6 +1024,12 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu.utils import (tree_add, tree_sub,
                                          tree_zeros_like)
 
+        if isinstance(dataset, ShardedDataset):
+            raise NotImplementedError(
+                "fidelity='host' stacks each worker's whole epoch in "
+                "its thread and does not stream shard files; "
+                "materialize with .to_dataset() if it fits, or use the "
+                "emulated fidelities for out-of-core data")
         rule = self.allocate_rule()
         codec = resolve_codec(self.compression)
         if codec is not None and rule.payload_kind != "delta":

@@ -1,0 +1,230 @@
+"""Out-of-core training data (data/sharded.py): metadata-only header
+reads, epoch streaming, and the equivalence contracts with the
+in-memory path (VERDICT.md round-2 Missing #2)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset, ShardedDataset, datasets
+from distkeras_tpu.models import model_config
+from distkeras_tpu.trainers import ADAG, SingleTrainer, SyncTrainer
+
+
+def _make(tmp_path, rows=512, shards=4, feat=(6,), classes=4, seed=0):
+    full = datasets.synthetic_classification(rows, feat, classes,
+                                             seed=seed)
+    paths = full.to_npz_shards(str(tmp_path / "part"),
+                               rows_per_shard=rows // shards)
+    return full, paths
+
+
+def test_metadata_without_loading(tmp_path):
+    full, paths = _make(tmp_path)
+    sd = Dataset.from_npz_shards(str(tmp_path / "part-*.npz"))
+    assert isinstance(sd, ShardedDataset)
+    assert len(sd) == len(full)
+    assert sd.num_shards == 4
+    assert sd.column_names == sorted(full.column_names)
+    assert sd.shard_rows == [128, 128, 128, 128]
+    # materialized content round-trips
+    np.testing.assert_array_equal(sd.to_dataset()["label"],
+                                  full["label"])
+
+
+def test_epoch_segments_cover_every_row_once(tmp_path):
+    full, paths = _make(tmp_path)
+    sd = ShardedDataset(paths)
+    seen = []
+    for seg in sd.epoch_segments(seed=3):
+        assert len(seg) == 128  # one shard at a time
+        seen.append(np.asarray(seg["features"]))
+    got = np.sort(np.concatenate(seen), axis=0)
+    want = np.sort(np.asarray(full["features"]), axis=0)
+    np.testing.assert_array_equal(got, want)
+    # deterministic in seed; different across seeds
+    a = [np.asarray(s["label"]) for s in sd.epoch_segments(seed=3)]
+    b = [np.asarray(s["label"]) for s in sd.epoch_segments(seed=3)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    c = np.concatenate(
+        [np.asarray(s["label"]) for s in sd.epoch_segments(seed=4)])
+    assert not np.array_equal(np.concatenate(a), c)
+
+
+def test_single_shard_training_is_bit_identical(tmp_path):
+    """One shard file == the in-memory epoch (same shuffle permutation),
+    so training is bit-identical — the equivalence contract."""
+    full, _ = _make(tmp_path, rows=256, shards=1)
+    path = full.to_npz(str(tmp_path / "whole.npz"))
+    sd = ShardedDataset([path])
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(8,))
+    kw = dict(worker_optimizer="sgd", learning_rate=0.05,
+              batch_size=32, num_epoch=2, seed=0)
+
+    t_mem = SingleTrainer(cfg, **kw)
+    t_mem.train(full)
+    t_ooc = SingleTrainer(cfg, **kw)
+    t_ooc.train(sd)
+    for a, b in zip(
+            np.asarray(t_mem.history["epoch_loss"]),
+            np.asarray(t_ooc.history["epoch_loss"])):
+        assert a == b, (a, b)
+    import jax
+
+    for pa, pb in zip(
+            jax.tree_util.tree_leaves(t_mem.trained_variables),
+            jax.tree_util.tree_leaves(t_ooc.trained_variables)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_multi_shard_single_trainer_converges(tmp_path):
+    full, paths = _make(tmp_path, rows=1024, shards=4)
+    sd = ShardedDataset(paths)
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(16,))
+    t = SingleTrainer(cfg, worker_optimizer="adam", learning_rate=5e-3,
+                      batch_size=32, num_epoch=3, seed=0)
+    t.train(sd)
+    losses = t.history["epoch_loss"]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_multi_shard_sync_and_ps_trainers(tmp_path):
+    full, paths = _make(tmp_path, rows=1024, shards=4)
+    sd = ShardedDataset(paths)
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(16,))
+    s = SyncTrainer(cfg, num_workers=4, batch_size=16, num_epoch=2,
+                    learning_rate=0.05, seed=0)
+    s.train(sd)
+    assert s.history["epoch_loss"][-1] < s.history["epoch_loss"][0]
+
+    a = ADAG(cfg, num_workers=4, communication_window=2, batch_size=8,
+             num_epoch=2, learning_rate=0.05, seed=0)
+    a.train(sd)
+    assert a.history["epoch_loss"][-1] < a.history["epoch_loss"][0]
+    # 4 segments x (256 rows / 4 workers / batch 8 = 8 batches -> 4
+    # rounds each) = 16 rounds/epoch over 2 epochs
+    assert len(a.history["round_loss"]) == 32
+
+
+def test_ps_checkpoint_resume_out_of_core(tmp_path):
+    """Kill/resume mid-epoch across segment boundaries is bitwise
+    deterministic (global round numbering)."""
+    full, paths = _make(tmp_path, rows=1024, shards=4)
+    sd = ShardedDataset(paths)
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(8,))
+    kw = dict(num_workers=4, communication_window=2, batch_size=8,
+              num_epoch=2, learning_rate=0.05, seed=0)
+
+    full_run = ADAG(cfg, **kw)
+    full_run.train(sd)
+
+    ck = str(tmp_path / "ck")
+    part = ADAG(cfg, checkpoint_dir=ck, checkpoint_every_rounds=3, **kw)
+
+    class Stop(Exception):
+        pass
+
+    calls = {"n": 0}
+    orig = ADAG._record
+
+    def bomb(self, **kwargs):
+        orig(self, **kwargs)
+        if "round_loss" in kwargs:
+            calls["n"] += 1
+            if calls["n"] == 5:
+                raise Stop()
+
+    ADAG._record = bomb
+    try:
+        with pytest.raises(Stop):
+            part.train(sd)
+    finally:
+        ADAG._record = orig
+    resumed = ADAG(cfg, **kw)
+    resumed.train(sd, resume_from=ck)
+    import jax
+
+    for pa, pb in zip(
+            jax.tree_util.tree_leaves(full_run.trained_variables),
+            jax.tree_util.tree_leaves(resumed.trained_variables)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_tiny_tail_shard_is_recorded_not_silent(tmp_path):
+    """A shard too small to give every worker a batch is dropped — and
+    the drop lands in history, never silently."""
+    full = datasets.synthetic_classification(512 + 7, (6,), 4, seed=0)
+    paths = full.to_npz_shards(str(tmp_path / "p"), rows_per_shard=256)
+    sd = ShardedDataset(paths)  # 256, 256, 7
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(8,))
+    a = ADAG(cfg, num_workers=4, communication_window=2, batch_size=8,
+             num_epoch=1, learning_rate=0.05, seed=0)
+    a.train(sd)
+    assert a.history["skipped_segment_rows"] == [7]
+
+
+def test_checkpoint_at_segment_boundary_fires_mid_epoch(tmp_path):
+    """checkpoint_every_rounds aligned with segment boundaries must
+    still produce mid-epoch saves (deferred to the next segment), and
+    resuming from one is bitwise-deterministic."""
+    full, paths = _make(tmp_path, rows=1024, shards=4)
+    sd = ShardedDataset(paths)
+    cfg = model_config("mlp", (6,), num_classes=4, hidden=(8,))
+    # 4 rounds per segment; every=4 lands exactly on each boundary
+    kw = dict(num_workers=4, communication_window=2, batch_size=8,
+              num_epoch=1, learning_rate=0.05, seed=0)
+    ck = str(tmp_path / "ckb")
+    t = ADAG(cfg, checkpoint_dir=ck, checkpoint_every_rounds=4, **kw)
+
+    from distkeras_tpu import checkpoint as ckpt_mod
+
+    saved_cursors = []
+    orig_save = ckpt_mod.save_checkpoint
+
+    def spy(path, state, cursor):
+        saved_cursors.append(dict(cursor))
+        return orig_save(path, state, cursor)
+
+    ckpt_mod.save_checkpoint = spy
+    try:
+        t.train(sd)
+    finally:
+        ckpt_mod.save_checkpoint = orig_save
+    # at least one mid-epoch boundary save happened (round 4, 8, or 12)
+    saved_rounds = {c.get("round") for c in saved_cursors
+                    if c.get("epoch") == 0}
+    assert saved_rounds & {4, 8, 12}, saved_cursors
+
+    full_run = ADAG(cfg, **kw)
+    full_run.train(sd)
+    import jax
+
+    for pa, pb in zip(
+            jax.tree_util.tree_leaves(full_run.trained_variables),
+            jax.tree_util.tree_leaves(t.trained_variables)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+def test_sharded_guards(tmp_path):
+    full, paths = _make(tmp_path)
+    with pytest.raises(ValueError, match="no files match"):
+        Dataset.from_npz_shards(str(tmp_path / "nope-*.npz"))
+    # mismatched columns across shards
+    Dataset({"x": np.zeros((4, 2))}).to_npz(str(tmp_path / "bad.npz"))
+    with pytest.raises(ValueError, match="columns"):
+        ShardedDataset([paths[0], str(tmp_path / "bad.npz")])
+    # mismatched row shape
+    Dataset({"features": np.zeros((4, 9), np.float32),
+             "label": np.zeros((4,), np.int64)}).to_npz(
+        str(tmp_path / "badshape.npz"))
+    with pytest.raises(ValueError, match="row shape"):
+        ShardedDataset([paths[0], str(tmp_path / "badshape.npz")])
+    # host arm rejects sharded input with a clear pointer
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    sd = ShardedDataset(paths)
+    t = DOWNPOUR(model_config("mlp", (6,), num_classes=4, hidden=(8,)),
+                 num_workers=2, fidelity="host", batch_size=8,
+                 num_epoch=1, learning_rate=0.01)
+    with pytest.raises(NotImplementedError, match="to_dataset"):
+        t.train(sd)
