@@ -99,10 +99,110 @@ def test_unsupported_layer_raises_by_name():
         from_keras_json(json.dumps(arch), input_shape=(5, 3))
 
 
-def test_functional_model_raises():
-    arch = {"class_name": "Functional", "config": {}}
-    with pytest.raises(NotImplementedError, match="Sequential"):
-        from_keras_json(json.dumps(arch), input_shape=(4,))
+def _functional_lenet():
+    inp = keras.Input((12, 12, 1))
+    h = keras.layers.Conv2D(6, 5, activation="relu",
+                            padding="same")(inp)
+    h = keras.layers.MaxPooling2D(2)(h)
+    h = keras.layers.Conv2D(16, 3, activation="relu")(h)
+    h = keras.layers.Flatten()(h)
+    h = keras.layers.Dense(32, activation="relu")(h)
+    out = keras.layers.Dense(10)(h)
+    return keras.Model(inp, out)
+
+
+def _functional_lstm():
+    inp = keras.Input((7,))
+    h = keras.layers.Embedding(30, 8)(inp)
+    h = keras.layers.LSTM(12)(h)
+    out = keras.layers.Dense(3)(h)
+    return keras.Model(inp, out)
+
+
+@pytest.mark.parametrize("maker,shape,x_int", [
+    (_functional_lenet, (12, 12, 1), False),
+    (_functional_lstm, (7,), True),
+])
+def test_functional_linear_chain_parity(maker, shape, x_int):
+    """Single-input single-output functional Model graphs ingest with
+    forward parity vs keras (VERDICT.md r2 Missing #1)."""
+    m = maker()
+    spec, variables = from_keras(m)
+    assert spec.input_shape == shape
+    rng = np.random.default_rng(0)
+    if x_int:
+        x = rng.integers(0, 30, size=(4, *shape)).astype(np.int32)
+    else:
+        x = rng.normal(size=(4, *shape)).astype(np.float32)
+    want = np.asarray(m(x))
+    got = np.asarray(spec.build().apply(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_functional_ingested_trains():
+    spec, variables = from_keras(_functional_lenet())
+    data = datasets.synthetic_classification(256, (12, 12, 1), 10,
+                                             seed=2)
+    t = SingleTrainer(spec.to_config(), worker_optimizer="adam",
+                      learning_rate=3e-3, batch_size=32, num_epoch=2,
+                      loss="categorical_crossentropy")
+    t.train(data, initial_variables=variables)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0], h
+
+
+def test_functional_dag_raises_naming_merge_layer():
+    inp = keras.Input((8,))
+    a = keras.layers.Dense(8, name="left")(inp)
+    b = keras.layers.Dense(8, name="right")(inp)
+    out = keras.layers.Add(name="the_merge")([a, b])
+    m = keras.Model(inp, out)
+    with pytest.raises(NotImplementedError) as e:
+        from_keras(m)
+    msg = str(e.value)
+    assert "linear chain" in msg
+    # the offending layer is named so the gap is visible, not silent
+    assert "the_merge" in msg or "left" in msg
+
+
+def test_functional_multi_input_raises():
+    a = keras.Input((4,), name="wide_in")
+    b = keras.Input((6,), name="deep_in")
+    ha = keras.layers.Dense(4)(a)
+    hb = keras.layers.Dense(4)(b)
+    out = keras.layers.Add()([ha, hb])
+    m = keras.Model([a, b], out)
+    with pytest.raises(NotImplementedError, match="multi-input"):
+        from_keras(m)
+
+
+def test_keras2_era_functional_json_parses():
+    """The reference era serialized functional models as class_name
+    'Model' with list-style inbound_nodes."""
+    arch = {
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"name": "in0", "class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"name": "d0", "class_name": "Dense",
+                 "config": {"units": 5, "activation": "relu"},
+                 "inbound_nodes": [[["in0", 0, 0, {}]]]},
+                {"name": "d1", "class_name": "Dense",
+                 "config": {"units": 2},
+                 "inbound_nodes": [[["d0", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in0", 0, 0]],
+            "output_layers": [["d1", 0, 0]],
+        },
+    }
+    spec, _ = from_keras_json(json.dumps(arch))
+    assert spec.input_shape == (6,)
+    x = np.zeros((2, 6), np.float32)
+    v = spec.build().init(jax.random.key(0), x)
+    assert spec.build().apply(v, x).shape == (2, 2)
 
 
 def test_weight_count_mismatch_raises():
