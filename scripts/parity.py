@@ -66,7 +66,7 @@ def main():
     from distkeras_tpu.data import datasets
     from distkeras_tpu.models import model_config
     from distkeras_tpu.trainers import (ADAG, AEASGD, DOWNPOUR, DynSGD,
-                                        SyncTrainer)
+                                        EAMSGD, SyncTrainer)
 
     import numpy as np
 
@@ -92,7 +92,14 @@ def main():
         ("ADAG", ADAG, {}),
         ("DynSGD", DynSGD, {}),
         ("DOWNPOUR", DOWNPOUR, {}),
-        ("AEASGD", AEASGD, {"rho": 2.5, "learning_rate": 0.02}),
+        # The elastic family runs at the SHARED lr: round 2 down-tuned
+        # AEASGD to lr=0.02 and recorded a -6.3-point gap that a
+        # rho x lr sweep showed was lr under-convergence, not an
+        # elastic-rule defect (gap at lr=0.05 is <0.005 for any rho in
+        # [1, 10]; at lr=0.1 AEASGD *beats* sync).  rho=2.5 is the
+        # paper-ish middle of the flat region.
+        ("AEASGD", AEASGD, {"rho": 2.5}),
+        ("EAMSGD", EAMSGD, {"rho": 2.5}),
         # the faithful concurrent arm (design 5a): real racing threads
         # against a host PS — validates the emulator's staleness
         # semantics (same UpdateRule math, emergent instead of
@@ -158,6 +165,21 @@ def main():
         "'int8 wire' row adds commit compression with error feedback "
         "(parallel/compression.py): its agreement shows the lossy wire "
         "does not cost convergence either.",
+        "",
+        "## Elastic-family tuning (round-3 sweep)",
+        "",
+        "Round 2 recorded AEASGD 6.3 points BELOW sync — the one arm "
+        "outside the acceptance bar.  A rho x lr sweep at this exact "
+        "scale (rho in {1, 2.5, 5, 10} x lr in {0.02, 0.05, 0.1}) "
+        "localized it: at the shared lr=0.05 the gap is < 0.005 for "
+        "EVERY rho, and at lr=0.1 AEASGD beats sync by +0.01; only the "
+        "lr=0.02 column (what round 2 ran) degrades, uniformly across "
+        "rho.  The regression was learning-rate under-convergence of "
+        "the local SGD, not elastic-pull damage; the elastic law is "
+        "lr-neutral in this regime.  EAMSGD (Nesterov workers) lands "
+        "ABOVE sync at every sweep point (+0.02..+0.026).  Both arms "
+        "now run at the shared lr and are CI-enforced "
+        "(tests/test_parity.py).",
     ]
     (REPO / "PARITY.md").write_text("\n".join(lines) + "\n")
     print(json.dumps({r["trainer"]: r["accuracy"] for r in results},

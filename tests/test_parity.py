@@ -8,7 +8,8 @@ import pytest
 from distkeras_tpu.data import datasets
 from distkeras_tpu.evaluators import evaluate_model
 from distkeras_tpu.models import model_config
-from distkeras_tpu.trainers import ADAG, DynSGD, SyncTrainer
+from distkeras_tpu.trainers import (ADAG, AEASGD, EAMSGD, DynSGD,
+                                    SyncTrainer)
 
 CFG = model_config("mlp", (16,), num_classes=8, hidden=(32,))
 _FULL = datasets.synthetic_classification(3072, (16,), 8, seed=0)
@@ -23,11 +24,17 @@ def _accuracy(trainer) -> float:
                           EVAL, batch_size=512)["accuracy"]
 
 
-@pytest.mark.parametrize("cls", [ADAG, DynSGD])
+# The elastic family (AEASGD/EAMSGD) runs at the SAME learning rate as
+# every other arm: the round-2 parity artifact down-tuned AEASGD to
+# lr=0.02 and recorded a -6.3-point "regression" that a rho x lr sweep
+# showed was pure lr under-convergence — at the shared lr the elastic
+# pull costs nothing at any rho in [1, 10] (PARITY.md).
+@pytest.mark.parametrize("cls", [ADAG, DynSGD, AEASGD, EAMSGD])
 def test_async_matches_sync_on_same_budget(cls):
     common = dict(batch_size=32, num_epoch=3, learning_rate=0.05, seed=0)
     sync_acc = _accuracy(SyncTrainer(CFG, num_workers=4, **common))
+    extra = {"rho": 2.5} if issubclass(cls, AEASGD) else {}
     async_acc = _accuracy(cls(CFG, num_workers=4,
-                              communication_window=2, **common))
+                              communication_window=2, **common, **extra))
     assert sync_acc > 0.7, sync_acc  # the control arm itself must learn
     assert async_acc > sync_acc - 0.10, (sync_acc, async_acc)
