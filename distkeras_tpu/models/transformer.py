@@ -136,6 +136,22 @@ class Block(nn.Module):
         return x + y
 
 
+class _BlockScanBody(nn.Module):
+    """``nn.scan``-compatible wrapper: ``(carry, _) -> (carry, None)``
+    around one ``Block`` so the layer stack's parameters materialize as
+    one stacked pytree (leading axis = layers) — the homogeneous form
+    pipeline parallelism slices per stage (``parallel.pipeline``)."""
+
+    num_heads: int
+    mlp_ratio: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, carry, _):
+        return Block(self.num_heads, self.mlp_ratio, self.dtype,
+                     name="layer")(carry), None
+
+
 @register_model("transformer_lm")
 class TransformerLM(nn.Module):
     """``seq_axis``: name of a mesh axis the *time* dimension is sharded
@@ -165,6 +181,12 @@ class TransformerLM(nn.Module):
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     expert_top_k: int = 1
+    #: stack the layer parameters [num_layers, ...] via nn.scan (same
+    #: math per layer; different param-tree layout).  Required by the
+    #: pipeline-parallel trainer path, which shards the layer stack's
+    #: leading axis across stages.  Incompatible with attn_fn/seq_axis/
+    #: MoE (those paths keep per-layer modules).
+    scan_blocks: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -194,10 +216,27 @@ class TransformerLM(nn.Module):
         pos = nn.Embed(self.max_len, self.d_model, dtype=dtype,
                        name="pos_embed")(positions)
         x = x + pos
-        for _ in range(self.num_layers):
-            x = Block(self.num_heads, self.mlp_ratio, dtype, attn_fn,
-                      self.num_experts, self.expert_capacity_factor,
-                      self.expert_top_k)(x)
+        if self.scan_blocks:
+            if (self.num_experts > 0 or self.attn_fn is not None
+                    or self.seq_axis is not None):
+                raise ValueError(
+                    "scan_blocks=True supports the dense-attention, "
+                    "dense-FFN transformer only (MoE / custom attn / "
+                    "seq_axis keep per-layer modules)")
+            scanned = nn.scan(
+                _BlockScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.num_layers)(
+                    self.num_heads, self.mlp_ratio, dtype,
+                    name="blocks")
+            x, _ = scanned(x, None)
+        else:
+            for _ in range(self.num_layers):
+                x = Block(self.num_heads, self.mlp_ratio, dtype,
+                          attn_fn, self.num_experts,
+                          self.expert_capacity_factor,
+                          self.expert_top_k)(x)
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)
