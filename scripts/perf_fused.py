@@ -18,6 +18,14 @@ input perturbation adds one full R+W of y2 per iteration to BOTH arms
 speedup).
 
 Usage:  PYTHONPATH=/root/repo python scripts/perf_fused.py
+
+CAVEAT (measured, unresolved): on the tunneled chip the K-step scan
+chains wrapping the Pallas custom-VJP calls compile for >10 minutes
+without completing (plain per-dispatch jits of the same ops compile in
+seconds).  Per-dispatch timing is the fallback here but is
+overhead-dominated (~13 ms floor).  The measurement that decided the
+fusion question is the END-TO-END A/B in ``perf_fused_e2e.py`` (full
+train step, 100+ ms, dispatch amortized) — PERF.md §11.
 """
 
 from __future__ import annotations

@@ -1,0 +1,183 @@
+"""Host-PS ceiling quantification at ResNet-18 scale (PERF.md §12).
+
+The reference's known scalability ceiling is the parameter server
+(SURVEY.md §2.4: GIL threads, full-weight pickle per window).  The
+rebuild's socket PS re-creates that architecture deliberately; this
+script measures where it saturates:
+
+Part 1 — raw PS throughput: N hammering threads, each loop = pull +
+commit of a ResNet-18-sized delta (~11.2M params, ~45 MB msgpack raw)
+against the real ``PSServer`` over loopback TCP.  Reports commits/sec
+and payload GB/s vs thread count, raw vs int8 wire.
+
+Part 2 — end-to-end stall fraction: DOWNPOUR(fidelity='host',
+transport='socket') training ResNet-18 @32px, ``PSClient.pull/commit``
+wall-time instrumented, for window in {1, 4, 16} x {raw, int8}.
+Reports rows/sec and the fraction of worker wall-time spent inside the
+PS exchange (the "worker-stall fraction").
+
+Run on CPU (the host arm's per-thread device programs are plain convs —
+no vmapped-conv slow path), so the wire path is measured without the
+TPU tunnel's 11 MB/s transfer distortion:
+    JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python scripts/perf_host_ps.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+
+
+def resnet18_center():
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.resnet import ResNet18
+
+    model = ResNet18(num_classes=10, dtype="float32")
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 32, 32, 3)))
+    params = jax.tree_util.tree_map(np.asarray, variables["params"])
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    return params, n
+
+
+def part1_raw_throughput(center, n_params, commits=8, workers_list=(1, 2, 4, 8)):
+    from distkeras_tpu.parallel.compression import resolve_codec
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSClient, PSServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+    from distkeras_tpu.utils import tree_zeros_like
+
+    delta = jax.tree_util.tree_map(
+        lambda x: (0.001 * np.ones_like(x)), center)
+    for codec_name in (None, "int8"):
+        codec = resolve_codec(codec_name)
+        payload = codec.encode(delta) if codec else delta
+        for workers in workers_list:
+            ps = HostParameterServer(DownpourRule(), center)
+            server = PSServer(ps, center).start()
+            host, port = server.address
+            barrier = threading.Barrier(workers + 1)
+            done = []
+
+            def worker(w):
+                client = PSClient(host, port, w, center,
+                                  codec=codec_name)
+                client.pull()
+                barrier.wait()  # start together
+                for s in range(commits):
+                    client.commit(payload, seq=s)
+                done.append(w)
+                client.close()
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            total = commits * workers
+            raw_bytes = sum(x.nbytes for x in
+                            jax.tree_util.tree_leaves(delta))
+            wire = (len(payload) if codec
+                    else raw_bytes)  # msgpack adds only framing
+            print(json.dumps({
+                "bench": "ps_raw", "wire": codec_name or "raw",
+                "workers": workers,
+                "commits_per_sec": round(total / dt, 2),
+                "payload_mb": round(wire / 1e6, 1),
+                "wire_gb_per_sec": round(total * wire / dt / 1e9, 3),
+            }), flush=True)
+            server.stop()
+            assert len(done) == workers
+
+
+def part2_e2e_stall(rows=256, workers=4):
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.parallel import host_ps
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    cfg = model_config("resnet", (32, 32, 3), num_classes=10,
+                       stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                       dtype="float32")
+
+    for codec in (None, "int8"):
+        for window in (1, 4, 16):
+            # at least 2 rounds per worker at every window
+            rows_w = max(rows, 2 * workers * 8 * window)
+            data = datasets.synthetic_classification(
+                rows_w, (32, 32, 3), 10, seed=0)
+            acc = {"t": 0.0, "n": 0}
+            acc_lock = threading.Lock()
+            orig_pull = host_ps.PSClient.pull
+            orig_commit = host_ps.PSClient.commit
+
+            def timed(fn):
+                def inner(self, *a, **k):
+                    t0 = time.perf_counter()
+                    out = fn(self, *a, **k)
+                    dt = time.perf_counter() - t0
+                    # worker threads race on these accumulators
+                    with acc_lock:
+                        acc["t"] += dt
+                        acc["n"] += 1
+                    return out
+                return inner
+
+            host_ps.PSClient.pull = timed(orig_pull)
+            host_ps.PSClient.commit = timed(orig_commit)
+            try:
+                t = DOWNPOUR(cfg, num_workers=workers,
+                             communication_window=window,
+                             batch_size=8, num_epoch=1,
+                             learning_rate=0.01, seed=0,
+                             fidelity="host", transport="socket",
+                             compression=codec)
+                t0 = time.perf_counter()
+                t.train(data)
+                wall = time.perf_counter() - t0
+            finally:
+                host_ps.PSClient.pull = orig_pull
+                host_ps.PSClient.commit = orig_commit
+            wire = sum(t.history.get("commit_wire_bytes", []))
+            out = {
+                "bench": "e2e", "wire": codec or "raw",
+                "window": window,
+                "rows": rows_w,
+                "rows_per_sec": round(rows_w / wall, 1),
+                "ps_calls": acc["n"],
+                "stall_fraction": round(acc["t"] / (workers * wall), 3),
+                "epoch_loss": round(t.history["epoch_loss"][-1], 3),
+            }
+            if wire:  # only the compressed arm tracks wire bytes
+                out["commit_wire_mb"] = round(wire / 1e6, 1)
+            print(json.dumps(out), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--commits", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=256)
+    ap.add_argument("--part", choices=["1", "2", "both"],
+                    default="both")
+    args = ap.parse_args()
+    center, n = resnet18_center()
+    print(json.dumps({"model": "resnet18", "params": n,
+                      "raw_mb": round(4 * n / 1e6, 1)}), flush=True)
+    if args.part in ("1", "both"):
+        part1_raw_throughput(center, n, commits=args.commits)
+    if args.part in ("2", "both"):
+        part2_e2e_stall(rows=args.rows)
+
+
+if __name__ == "__main__":
+    main()
