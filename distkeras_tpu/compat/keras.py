@@ -18,8 +18,17 @@ takes a live model.
 Supported layers: InputLayer, Dense, Activation, Dropout, Flatten,
 Conv2D, MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D,
 Embedding, BatchNormalization, LSTM, Bidirectional(LSTM) — the
-reference's IMDB workflow shape.  Anything else raises with the layer
-name so the gap is visible, not silent.
+reference's IMDB workflow shape — plus the merge layers (Add /
+Subtract / Multiply / Average / Maximum / Concatenate) for functional
+DAGs.  Anything else raises with the layer name so the gap is
+visible, not silent.
+
+Model topologies: ``Sequential``; functional ``Model(inputs,
+outputs)`` graphs — linear chains lower to the ``keras_sequential``
+family, true DAGs (branches + merges) to ``keras_graph``; multi-input
+models whose inputs are all rank-1 ingest as ONE concatenated features
+array with per-input column slices (the reference-era Wide&Deep
+shape).  Multi-output models and shared (twice-called) layers raise.
 """
 
 from __future__ import annotations
@@ -47,6 +56,17 @@ _ACTIVATIONS = {
     "swish": nn.swish,
     "silu": nn.silu,
     "leaky_relu": nn.leaky_relu,
+}
+
+
+# keras merge layers -> normalized kinds (the DAG walker's join nodes)
+_MERGE_KINDS = {
+    "Add": "merge_add",
+    "Subtract": "merge_subtract",
+    "Multiply": "merge_multiply",
+    "Average": "merge_average",
+    "Maximum": "merge_maximum",
+    "Concatenate": "merge_concat",
 }
 
 
@@ -118,6 +138,15 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
         return {"kind": "embedding",
                 "input_dim": int(cfg["input_dim"]),
                 "output_dim": int(cfg["output_dim"])}
+    if class_name in _MERGE_KINDS:
+        norm = {"kind": _MERGE_KINDS[class_name]}
+        if class_name == "Concatenate":
+            axis = cfg.get("axis", -1)
+            if axis != -1:
+                raise NotImplementedError(
+                    f"Concatenate over axis {axis!r} is not supported; "
+                    f"only the last (feature) axis")
+        return norm
     if class_name == "LSTM":
         return _normalize_lstm(cfg, kind="lstm")
     if class_name == "Bidirectional":
@@ -227,111 +256,163 @@ def _inbound_names(node) -> list[str]:
     return names
 
 
-def _single_ref_name(refs) -> str | None:
-    """Layer name out of ``input_layers``/``output_layers``, which is
-    ``[name, 0, 0]`` (one ref, keras 3) or ``[[name, 0, 0], ...]``
-    (list of refs, keras 2) — ``None`` when there is more than one."""
+def _ref_names(refs) -> list[str]:
+    """Layer names out of ``input_layers``/``output_layers``: either
+    one ``[name, 0, 0]`` ref (keras 3 single), a list of such refs
+    (multi / keras 2), or a bare name list."""
     if not refs:
-        return None
-    if isinstance(refs[0], str):  # single [name, 0, 0]
-        return refs[0]
-    if len(refs) != 1:
-        return None
-    return refs[0][0]
+        return []
+    if isinstance(refs[0], str):
+        # ["name", 0, 0] (single ref) vs ["a", "b"] (keras-3 multi)
+        if len(refs) == 3 and refs[1:] == [0, 0]:
+            return [refs[0]]
+        return [r for r in refs if isinstance(r, str)]
+    return [r[0] for r in refs]
 
 
-def _parse_functional(arch: Mapping[str, Any]) -> list[dict]:
-    """Linear-chain functional ``Model(inputs, outputs)`` graphs →
-    the same normalized layer list as Sequential.
+def _parse_functional(arch: Mapping[str, Any]) -> dict:
+    """Functional ``Model(inputs, outputs)`` graphs → a JSON-able graph
+    spec (round-2 ingested linear chains only; the topology walker now
+    covers true DAGs — branches and merge layers).
 
-    True DAGs are rejected with the offending merge/branch layer named
-    (VERDICT.md r2 Missing #1): multi-input models, layers with
-    multiple inbound tensors (Add/Concatenate/...), shared layers
-    (called more than once), and branching outputs all raise."""
+    Supported: single-output DAGs built from the normalized layer set
+    plus the merge layers (Add/Subtract/Multiply/Average/Maximum/
+    Concatenate).  Multi-INPUT models ingest when every input is
+    rank-1 ``[None, d]``: the inputs concatenate (in ``input_layers``
+    order) into one features array and each Input node slices its
+    columns back out — the reference-era Wide&Deep shape.  Still
+    rejected, loudly: shared layers (called more than once),
+    multi-output models, and multi-input models with higher-rank
+    inputs."""
     config = arch.get("config", {})
     raw_layers = config.get("layers", [])
     if not raw_layers:
         raise ValueError("keras architecture contains no layers")
+    names: list[str] = []
     by_name: dict[str, dict] = {}
     preds: dict[str, list[str]] = {}
     for entry in raw_layers:
         name = entry.get("name") or entry.get("config", {}).get("name")
         if name is None:
             raise ValueError("functional layer entry has no name")
+        names.append(name)
         by_name[name] = entry
         nodes = entry.get("inbound_nodes", [])
         if len(nodes) > 1:
             raise NotImplementedError(
                 f"layer {name!r} is called {len(nodes)} times (shared "
-                f"layer); only linear-chain functional graphs are "
-                f"supported")
+                f"layer); weight mapping for shared layers is "
+                f"ambiguous — rebuild natively")
         preds[name] = _inbound_names(nodes[0]) if nodes else []
 
-    in_name = _single_ref_name(config.get("input_layers", []))
-    out_name = _single_ref_name(config.get("output_layers", []))
-    if in_name is None or out_name is None:
+    out_names = _ref_names(config.get("output_layers", []))
+    if len(out_names) != 1:
         raise NotImplementedError(
-            "multi-input / multi-output functional models are not "
-            "supported; only single-input single-output linear chains "
-            "(rebuild true DAGs natively with distkeras_tpu.models, "
-            "e.g. models.WideDeep for two-branch configs)")
+            f"multi-output functional models are not supported "
+            f"(outputs: {out_names}); only a single output head")
+    in_names = _ref_names(config.get("input_layers", []))
+    if not in_names:
+        raise ValueError("functional model declares no input layers")
 
-    for name, p in preds.items():
-        if len(p) > 1:
-            cls = by_name[name]["class_name"]
-            raise NotImplementedError(
-                f"functional graph is not a linear chain: layer "
-                f"{name!r} ({cls}) merges {len(p)} inputs "
-                f"({', '.join(p)}); merge layers make a true DAG — "
-                f"rebuild natively with distkeras_tpu.models")
+    # Multi-input: every input must be rank-1; slices concatenate in
+    # input_layers order.
+    input_slices = []
+    if len(in_names) > 1:
+        start = 0
+        for n in in_names:
+            cfg_n = by_name[n].get("config", {})
+            shape = (cfg_n.get("batch_shape")
+                     or cfg_n.get("batch_input_shape"))
+            if shape is None or len(shape) != 2 or shape[1] is None:
+                raise NotImplementedError(
+                    f"multi-input ingestion needs every input rank-1 "
+                    f"with a known width ([None, d]); input {n!r} has "
+                    f"batch shape {shape!r} — rebuild natively (e.g. "
+                    f"models.WideDeep for two-branch configs)")
+            width = int(shape[1])
+            input_slices.append([n, start, start + width])
+            start += width
 
-    successors: dict[str, list[str]] = {}
-    for name, p in preds.items():
-        for q in p:
-            successors.setdefault(q, []).append(name)
-    for name, succ in successors.items():
-        if len(succ) > 1:
-            raise NotImplementedError(
-                f"functional graph is not a linear chain: layer "
-                f"{name!r} branches into {', '.join(sorted(succ))}")
+    # Kahn topological order over the whole graph.
+    pending = {n: len(preds[n]) for n in names}
+    ready = [n for n in names if pending[n] == 0]
+    topo: list[str] = []
+    while ready:
+        cur = ready.pop(0)
+        topo.append(cur)
+        for m in names:
+            if cur in preds[m]:
+                pending[m] -= preds[m].count(cur)
+                if pending[m] == 0:
+                    ready.append(m)
+    if len(topo) != len(names):
+        raise ValueError(
+            f"functional graph is cyclic or disconnected at "
+            f"{sorted(set(names) - set(topo))}")
 
-    # walk the chain from input to output
-    chain, cur = [in_name], in_name
-    while cur != out_name:
-        nxt = successors.get(cur, [])
-        if not nxt:
-            raise ValueError(
-                f"functional graph ends at {cur!r} without reaching "
-                f"the declared output {out_name!r}")
-        cur = nxt[0]
-        chain.append(cur)
-    unused = set(by_name) - set(chain)
-    if unused:
-        raise NotImplementedError(
-            f"functional graph has layers outside the input->output "
-            f"chain: {sorted(unused)}")
+    id_of = {n: i for i, n in enumerate(names)}  # config-list position
+    nodes = []
+    for n in names:
+        entry = by_name[n]
+        if entry["class_name"] == "InputLayer" or n in in_names:
+            node = {"kind": "input"}
+        else:
+            node = _normalize_layer(entry["class_name"],
+                                    entry.get("config", {}))
+            if node is None:  # InputLayer is routed above; cannot occur
+                raise AssertionError(entry["class_name"])
+            p = preds[n]
+            if node["kind"].startswith("merge_"):
+                if len(p) < 2:
+                    raise ValueError(
+                        f"merge layer {n!r} has {len(p)} inputs")
+            elif len(p) != 1:
+                raise NotImplementedError(
+                    f"layer {n!r} ({entry['class_name']}) takes "
+                    f"{len(p)} input tensors; only merge layers may "
+                    f"take several")
+        node["id"] = id_of[n]
+        node["inputs"] = [id_of[q] for q in preds[n]]
+        nodes.append(node)
 
-    layers = []
-    for name in chain:
-        entry = by_name[name]
-        norm = _normalize_layer(entry["class_name"],
-                                entry.get("config", {}))
-        if norm is not None:
-            layers.append(norm)
-    if not layers:
-        raise ValueError("keras architecture contains no layers")
-    return layers
+    return {
+        "nodes": nodes,                       # config-list order
+        "topo": [id_of[n] for n in topo],
+        "output": id_of[out_names[0]],
+        "input_slices": [[id_of[n], a, b] for n, a, b in input_slices],
+    }
+
+
+def _graph_is_chain(graph: dict) -> list[dict] | None:
+    """A single-input, merge-free, branch-free DAG whose config-list
+    order is already executable (keras serializes layers in its own
+    topological order, which is also ``get_weights()`` order) is a
+    plain chain: return its normalized layer list so it lowers to the
+    simpler ``keras_sequential`` family; ``None`` otherwise."""
+    nodes = graph["nodes"]
+    n_inputs = sum(1 for n in nodes if n["kind"] == "input")
+    if n_inputs != 1:
+        return None
+    succ_count: dict[int, int] = {}
+    for n in nodes:
+        if n["kind"].startswith("merge_"):
+            return None
+        for i in n["inputs"]:
+            succ_count[i] = succ_count.get(i, 0) + 1
+        if any(i >= n["id"] for i in n["inputs"]):
+            return None  # config order not executable: graph path
+    if any(c > 1 for c in succ_count.values()):
+        return None
+    return [{k: v for k, v in n.items() if k not in ("id", "inputs")}
+            for n in nodes if n["kind"] != "input"]
 
 
 def _parse_arch(arch: Mapping[str, Any]) -> list[dict]:
     class_name = arch.get("class_name")
-    if class_name in ("Functional", "Model"):
-        # keras 2 called functional models "Model"; 2.4+/3 "Functional"
-        return _parse_functional(arch)
     if class_name != "Sequential":
         raise NotImplementedError(
-            f"only Sequential and linear-chain Functional keras "
-            f"models are supported, got {class_name!r}")
+            f"only Sequential and Functional keras models are "
+            f"supported, got {class_name!r}")
     config = arch.get("config", {})
     # Keras 1 stored the layer list directly under config; 2/3 under
     # config["layers"].
@@ -339,6 +420,11 @@ def _parse_arch(arch: Mapping[str, Any]) -> list[dict]:
                   else config.get("layers", []))
     layers = []
     for entry in raw_layers:
+        if entry["class_name"] in _MERGE_KINDS:
+            raise NotImplementedError(
+                f"merge layer {entry['class_name']!r} cannot appear in "
+                f"a Sequential model (it takes multiple inputs); "
+                f"export the functional Model instead")
         norm = _normalize_layer(entry["class_name"],
                                 entry.get("config", {}))
         if norm is not None:
@@ -367,68 +453,146 @@ class KerasSequential(nn.Module):
         dtype = jnp.dtype(self.dtype)
         x = jnp.asarray(x, dtype)
         for i, layer in enumerate(self.layers):
-            kind = layer["kind"]
-            name = f"layer_{i}"
-            if kind == "dense":
-                # contracts the last axis, any rank — keras semantics
-                x = nn.Dense(layer["units"],
-                             use_bias=layer["use_bias"],
-                             dtype=dtype, name=name)(x)
-                x = _activation(layer["activation"])(x)
-            elif kind == "activation":
-                x = _activation(layer["activation"])(x)
-            elif kind == "dropout":
-                x = nn.Dropout(layer["rate"],
-                               deterministic=not train)(x)
-            elif kind == "flatten":
-                x = x.reshape((x.shape[0], -1))
-            elif kind == "conv2d":
-                x = nn.Conv(layer["filters"],
-                            tuple(layer["kernel_size"]),
-                            strides=tuple(layer["strides"]),
-                            padding=layer["padding"],
-                            use_bias=layer["use_bias"],
-                            dtype=dtype, name=name)(x)
-                x = _activation(layer["activation"])(x)
-            elif kind == "pool":
-                fn = nn.max_pool if layer["op"] == "max" else nn.avg_pool
-                x = fn(x, tuple(layer["pool_size"]),
-                       strides=tuple(layer["strides"]),
-                       padding=layer["padding"])
-            elif kind == "global_avg_pool":
-                x = x.mean(axis=(1, 2))
-            elif kind == "embedding":
-                x = nn.Embed(layer["input_dim"], layer["output_dim"],
-                             dtype=dtype, name=name)(
-                                 x.astype(jnp.int32))
-            elif kind == "batchnorm":
-                x = nn.BatchNorm(use_running_average=not train,
-                                 epsilon=layer["epsilon"],
-                                 momentum=layer["momentum"],
-                                 dtype=dtype, name=name)(x)
-            elif kind == "lstm":
-                # the RNN wrapper owns no params; naming the CELL is
-                # what pins the weight-mapping path
-                y = nn.RNN(nn.OptimizedLSTMCell(layer["units"],
-                                                dtype=dtype,
-                                                name=name))(x)
-                x = y if layer["return_sequences"] else y[:, -1]
-            elif kind == "bilstm":
-                # keras Bidirectional(LSTM, merge_mode='concat'):
-                # backward outputs are time-aligned (keep_order); its
-                # "last" output is the one at original index 0
-                yf = nn.RNN(nn.OptimizedLSTMCell(
-                    layer["units"], dtype=dtype, name=name + "_fwd"))(x)
-                yb = nn.RNN(nn.OptimizedLSTMCell(
-                    layer["units"], dtype=dtype, name=name + "_bwd"),
-                    reverse=True, keep_order=True)(x)
-                if layer["return_sequences"]:
-                    x = jnp.concatenate([yf, yb], axis=-1)
-                else:
-                    x = jnp.concatenate([yf[:, -1], yb[:, 0]], axis=-1)
-            else:  # unreachable: _normalize_layer gates kinds
-                raise AssertionError(kind)
+            x = _apply_layer(layer, f"layer_{i}", x, dtype, train)
         return x
+
+
+def _apply_layer(layer, name: str, x, dtype, train: bool):
+    """One normalized layer's forward.  Called from inside a module's
+    ``@nn.compact`` ``__call__`` — flax binds the submodules created
+    here to the calling module, so ``KerasSequential`` and
+    ``KerasGraph`` share one per-kind implementation (and one
+    weight-mapping convention)."""
+    kind = layer["kind"]
+    if kind == "dense":
+        # contracts the last axis, any rank — keras semantics
+        x = nn.Dense(layer["units"], use_bias=layer["use_bias"],
+                     dtype=dtype, name=name)(x)
+        return _activation(layer["activation"])(x)
+    if kind == "activation":
+        return _activation(layer["activation"])(x)
+    if kind == "dropout":
+        return nn.Dropout(layer["rate"], deterministic=not train)(x)
+    if kind == "flatten":
+        return x.reshape((x.shape[0], -1))
+    if kind == "conv2d":
+        x = nn.Conv(layer["filters"], tuple(layer["kernel_size"]),
+                    strides=tuple(layer["strides"]),
+                    padding=layer["padding"],
+                    use_bias=layer["use_bias"],
+                    dtype=dtype, name=name)(x)
+        return _activation(layer["activation"])(x)
+    if kind == "pool":
+        fn = nn.max_pool if layer["op"] == "max" else nn.avg_pool
+        return fn(x, tuple(layer["pool_size"]),
+                  strides=tuple(layer["strides"]),
+                  padding=layer["padding"])
+    if kind == "global_avg_pool":
+        return x.mean(axis=(1, 2))
+    if kind == "embedding":
+        return nn.Embed(layer["input_dim"], layer["output_dim"],
+                        dtype=dtype, name=name)(x.astype(jnp.int32))
+    if kind == "batchnorm":
+        return nn.BatchNorm(use_running_average=not train,
+                            epsilon=layer["epsilon"],
+                            momentum=layer["momentum"],
+                            dtype=dtype, name=name)(x)
+    if kind == "lstm":
+        # the RNN wrapper owns no params; naming the CELL is what pins
+        # the weight-mapping path
+        y = nn.RNN(nn.OptimizedLSTMCell(layer["units"], dtype=dtype,
+                                        name=name))(x)
+        return y if layer["return_sequences"] else y[:, -1]
+    if kind == "bilstm":
+        # keras Bidirectional(LSTM, merge_mode='concat'): backward
+        # outputs are time-aligned (keep_order); its "last" output is
+        # the one at original index 0
+        yf = nn.RNN(nn.OptimizedLSTMCell(
+            layer["units"], dtype=dtype, name=name + "_fwd"))(x)
+        yb = nn.RNN(nn.OptimizedLSTMCell(
+            layer["units"], dtype=dtype, name=name + "_bwd"),
+            reverse=True, keep_order=True)(x)
+        if layer["return_sequences"]:
+            return jnp.concatenate([yf, yb], axis=-1)
+        return jnp.concatenate([yf[:, -1], yb[:, 0]], axis=-1)
+    raise AssertionError(kind)  # unreachable: _normalize_layer gates
+
+
+def _apply_merge(kind: str, ins):
+    if kind == "merge_concat":
+        return jnp.concatenate(ins, axis=-1)
+    if kind == "merge_add":
+        out = ins[0]
+        for y in ins[1:]:
+            out = out + y
+        return out
+    if kind == "merge_subtract":
+        if len(ins) != 2:
+            raise ValueError(
+                f"Subtract takes exactly 2 inputs, got {len(ins)}")
+        return ins[0] - ins[1]
+    if kind == "merge_multiply":
+        out = ins[0]
+        for y in ins[1:]:
+            out = out * y
+        return out
+    if kind == "merge_average":
+        out = ins[0]
+        for y in ins[1:]:
+            out = out + y
+        return out / len(ins)
+    if kind == "merge_maximum":
+        out = ins[0]
+        for y in ins[1:]:
+            out = jnp.maximum(out, y)
+        return out
+    raise AssertionError(kind)
+
+
+@register_model("keras_graph")
+class KerasGraph(nn.Module):
+    """Flax twin of an ingested keras functional DAG.
+
+    ``nodes`` is ``_parse_functional``'s node list in config-list order
+    (= the keras ``get_weights()`` order — parameterized nodes are
+    named ``layer_{id}`` by that position); ``topo`` is an executable
+    order; ``output`` the result node id.  ``input_slices`` (multi-
+    input models) map each Input node to its column slice of the single
+    concatenated features array; empty means one Input taking ``x``
+    whole."""
+
+    nodes: Sequence[Mapping[str, Any]] = ()
+    topo: Sequence[int] = ()
+    output: int = 0
+    input_slices: Sequence[Sequence[int]] = ()
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = jnp.asarray(x, dtype)
+        by_id = {int(n["id"]): n for n in self.nodes}
+        slices = {int(i): (int(a), int(b))
+                  for i, a, b in self.input_slices}
+        outs: dict[int, Any] = {}
+        for nid in self.topo:
+            node = by_id[int(nid)]
+            kind = node["kind"]
+            if kind == "input":
+                if int(nid) in slices:
+                    a, b = slices[int(nid)]
+                    outs[int(nid)] = x[..., a:b]
+                else:
+                    outs[int(nid)] = x
+                continue
+            ins = [outs[int(i)] for i in node["inputs"]]
+            if kind.startswith("merge_"):
+                outs[int(nid)] = _apply_merge(kind, ins)
+            else:
+                outs[int(nid)] = _apply_layer(
+                    node, f"layer_{int(node['id'])}", ins[0], dtype,
+                    train)
+        return outs[int(self.output)]
 
 
 def _lstm_cell_params(W: np.ndarray, U: np.ndarray,
@@ -465,6 +629,21 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
     moving_var]``, LSTM ``[kernel (in, 4u), recurrent (u, 4u),
     bias (4u)]`` with gate order i, f, g(c), o (Bidirectional: forward
     triple then backward triple)."""
+    return _map_named_weights(
+        [(f"layer_{i}", layer) for i, layer in enumerate(layers)],
+        weights)
+
+
+def _map_graph_weights(graph: dict,
+                       weights: Sequence[np.ndarray]) -> dict:
+    """Weight mapping for a ``KerasGraph``: nodes consumed in
+    config-list order, which is how keras serializes its own
+    topological order (= ``get_weights()`` order)."""
+    return _map_named_weights(
+        [(f"layer_{n['id']}", n) for n in graph["nodes"]], weights)
+
+
+def _map_named_weights(named_layers, weights) -> dict:
     weights = [np.asarray(w) for w in weights]
     params: dict[str, Any] = {}
     batch_stats: dict[str, Any] = {}
@@ -480,8 +659,22 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
         pos += 1
         return w
 
-    for i, layer in enumerate(layers):
-        kind, name = layer["kind"], f"layer_{i}"
+    _consume_layers(named_layers, take, params, batch_stats)
+    if pos != len(weights):
+        raise ValueError(
+            f"keras weight list has {len(weights)} arrays but the "
+            f"architecture consumes {pos}")
+    variables: dict[str, Any] = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    return variables
+
+
+def _consume_layers(named_layers, take, params, batch_stats):
+    """Shared weight-consumption walk for the sequential and graph
+    families (keras lists arrays per layer in creation order)."""
+    for name, layer in named_layers:
+        kind = layer["kind"]
         if kind in ("dense", "conv2d"):
             entry = {"kernel": take()}
             if layer["use_bias"]:
@@ -499,14 +692,6 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
                 take(), take(), take())
             params[name + "_bwd"] = _lstm_cell_params(
                 take(), take(), take())
-    if pos != len(weights):
-        raise ValueError(
-            f"keras weight list has {len(weights)} arrays but the "
-            f"architecture consumes {pos}")
-    variables: dict[str, Any] = {"params": params}
-    if batch_stats:
-        variables["batch_stats"] = batch_stats
-    return variables
 
 
 def from_keras_json(arch_json: str,
@@ -515,14 +700,30 @@ def from_keras_json(arch_json: str,
                     dtype: str = "float32"):
     """Ingest ``model.to_json()`` (+ optional ``model.get_weights()``).
 
-    Returns ``(spec, variables)`` — a ``ModelSpec`` of family
-    ``keras_sequential`` usable with every trainer, and the mapped flax
-    variables (``None`` when no weights were given; pass the variables
+    Returns ``(spec, variables)`` — a ``ModelSpec`` usable with every
+    trainer (family ``keras_sequential`` for Sequential models and
+    functional chains; ``keras_graph`` for true functional DAGs, whose
+    kwargs carry the node graph instead of a layer list), and the
+    mapped flax variables (``None`` when no weights were given; pass the variables
     as ``initial_variables=`` to continue training, or to a predictor /
     evaluator directly).  ``input_shape`` (per-sample, no batch dim) is
     required only when the JSON does not record one."""
     arch = json.loads(arch_json)
-    layers = _parse_arch(arch)
+    if arch.get("class_name") in ("Functional", "Model"):
+        # keras 2 called functional models "Model"; 2.4+/3 "Functional"
+        graph = _parse_functional(arch)
+        chain = _graph_is_chain(graph)
+        if chain is not None:
+            if not chain:
+                raise ValueError(
+                    "keras architecture contains no layers (the model "
+                    "maps its input straight to output)")
+            layers = chain  # lowers to the simpler sequential family
+        else:
+            return _graph_spec(graph, arch, weights, input_shape,
+                               dtype)
+    else:
+        layers = _parse_arch(arch)
     if input_shape is None:
         input_shape = _infer_input_shape(arch)
         if input_shape is None:
@@ -537,6 +738,50 @@ def from_keras_json(arch_json: str,
                      input_dtype=input_dtype)
     variables = (None if weights is None
                  else _map_weights(layers, weights))
+    return spec, variables
+
+
+def _graph_spec(graph, arch, weights, input_shape, dtype):
+    """ModelSpec + variables for a true-DAG functional model
+    (``KerasGraph`` family)."""
+    if graph["input_slices"]:
+        # multi-input: one concatenated features array, width = the
+        # inputs' total (input_shape= cannot override a recorded total)
+        total = graph["input_slices"][-1][2]
+        if input_shape is not None \
+                and tuple(input_shape) != (total,):
+            raise ValueError(
+                f"multi-input model concatenates its inputs into "
+                f"[N, {total}]; input_shape={tuple(input_shape)} "
+                f"conflicts")
+        input_shape = (total,)
+    elif input_shape is None:
+        input_shape = _infer_input_shape(arch)
+        if input_shape is None:
+            raise ValueError(
+                "the keras JSON records no input shape (the model was "
+                "never built); pass input_shape=")
+    # int32 features only when EVERY consumer of every input node is an
+    # embedding (mixed wide&deep-style inputs stay float; the embedding
+    # branch casts its own slice)
+    input_ids = {n["id"] for n in graph["nodes"]
+                 if n["kind"] == "input"}
+    consumers = [n for n in graph["nodes"]
+                 if any(i in input_ids for i in n["inputs"])]
+    input_dtype = ("int32" if consumers and all(
+        n["kind"] == "embedding" for n in consumers) else "float32")
+    spec = ModelSpec(
+        family="keras_graph",
+        kwargs={"nodes": tuple(graph["nodes"]),
+                "topo": tuple(graph["topo"]),
+                "output": graph["output"],
+                "input_slices": tuple(tuple(s) for s in
+                                      graph["input_slices"]),
+                "dtype": dtype},
+        input_shape=tuple(int(d) for d in input_shape),
+        input_dtype=input_dtype)
+    variables = (None if weights is None
+                 else _map_graph_weights(graph, weights))
     return spec, variables
 
 

@@ -48,9 +48,8 @@ def _run(args):
     shard_dir = args.shard_dir or tempfile.mkdtemp(prefix="dkt_shards_")
     full = datasets.synthetic_classification(args.rows, (16,), 8,
                                              seed=args.seed)
-    paths = full.to_npz_shards(str(Path(shard_dir) / "part"),
-                               rows_per_shard=max(
-                                   1, args.rows // args.shards))
+    full.to_npz_shards(str(Path(shard_dir) / "part"),
+                       rows_per_shard=max(1, args.rows // args.shards))
     sharded = Dataset.from_npz_shards(str(Path(shard_dir) / "part-*.npz"))
     print(f"wrote {sharded.num_shards} shards, {len(sharded)} rows, "
           f"columns {sharded.column_names}")

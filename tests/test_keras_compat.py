@@ -151,29 +151,128 @@ def test_functional_ingested_trains():
     assert h[-1] < h[0], h
 
 
-def test_functional_dag_raises_naming_merge_layer():
+@pytest.fixture()
+def _f32_matmuls():
+    # keras/TF computes true f32; pin jax's matmul precision so DAG
+    # parity asserts numerics, not the platform's bf16-style default
+    with jax.default_matmul_precision("float32"):
+        yield
+
+
+def test_functional_dag_with_merge_ingests(_f32_matmuls):
+    """Branch + Add merge (a residual MLP) round-trips through the
+    keras_graph family with forward parity."""
     inp = keras.Input((8,))
-    a = keras.layers.Dense(8, name="left")(inp)
-    b = keras.layers.Dense(8, name="right")(inp)
-    out = keras.layers.Add(name="the_merge")([a, b])
+    a = keras.layers.Dense(8, activation="relu", name="left")(inp)
+    b = keras.layers.Dense(8, name="right")(a)
+    res = keras.layers.Add(name="the_merge")([a, b])
+    out = keras.layers.Dense(3)(keras.layers.Activation("relu")(res))
     m = keras.Model(inp, out)
-    with pytest.raises(NotImplementedError) as e:
-        from_keras(m)
-    msg = str(e.value)
-    assert "linear chain" in msg
-    # the offending layer is named so the gap is visible, not silent
-    assert "the_merge" in msg or "left" in msg
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_graph"
+    x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
 
 
-def test_functional_multi_input_raises():
-    a = keras.Input((4,), name="wide_in")
-    b = keras.Input((6,), name="deep_in")
-    ha = keras.layers.Dense(4)(a)
-    hb = keras.layers.Dense(4)(b)
-    out = keras.layers.Add()([ha, hb])
-    m = keras.Model([a, b], out)
-    with pytest.raises(NotImplementedError, match="multi-input"):
+@pytest.mark.parametrize("merge,klass", [
+    ("concat", "Concatenate"),
+    ("average", "Average"),
+    ("maximum", "Maximum"),
+    ("subtract", "Subtract"),
+    ("multiply", "Multiply"),
+])
+def test_functional_merge_layers_parity(_f32_matmuls, merge, klass):
+    inp = keras.Input((6,))
+    a = keras.layers.Dense(5, activation="tanh")(inp)
+    b = keras.layers.Dense(5)(inp)
+    join = getattr(keras.layers, klass)()([a, b])
+    out = keras.layers.Dense(2)(join)
+    m = keras.Model(inp, out)
+    spec, variables = from_keras(m)
+    x = np.random.default_rng(3).normal(size=(4, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_functional_multi_input_wide_deep(_f32_matmuls):
+    """A two-input wide&deep-style model ingests as one concatenated
+    features array with per-input column slices — the reference-era
+    Criteo shape."""
+    wide = keras.Input((5,), name="wide")
+    deep = keras.Input((7,), name="deep")
+    d = keras.layers.Dense(6, activation="relu")(deep)
+    d = keras.layers.Dense(4, activation="relu")(d)
+    join = keras.layers.Concatenate()([wide, d])
+    out = keras.layers.Dense(2)(join)
+    m = keras.Model([wide, deep], out)
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_graph"
+    assert spec.input_shape == (12,)  # 5 + 7, input_layers order
+    rng = np.random.default_rng(1)
+    xa = rng.normal(size=(4, 5)).astype(np.float32)
+    xb = rng.normal(size=(4, 7)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(
+            variables, np.concatenate([xa, xb], axis=1))),
+        np.asarray(m([xa, xb])), rtol=1e-5, atol=1e-5)
+
+
+def test_functional_graph_spec_survives_json_roundtrip(_f32_matmuls):
+    inp = keras.Input((6,))
+    a = keras.layers.Dense(6)(inp)
+    res = keras.layers.Add()([inp, a])
+    m = keras.Model(inp, keras.layers.Dense(2)(res))
+    spec, variables = from_keras(m)
+    rebuilt = json.loads(json.dumps(spec.to_config()))
+    from distkeras_tpu.models import ModelSpec
+
+    spec2 = ModelSpec.from_config(rebuilt)
+    x = np.random.default_rng(4).normal(size=(3, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec2.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_ingested_dag_trains():
+    inp = keras.Input((8,))
+    a = keras.layers.Dense(16, activation="relu")(inp)
+    b = keras.layers.Dense(16)(inp)
+    out = keras.layers.Dense(4)(keras.layers.Add()([a, b]))
+    spec, variables = from_keras(keras.Model(inp, out))
+    data = datasets.synthetic_classification(512, (8,), 4, seed=5)
+    t = SingleTrainer(spec.to_config(), worker_optimizer="adam",
+                      learning_rate=3e-3, batch_size=32, num_epoch=3,
+                      loss="categorical_crossentropy")
+    t.train(data, initial_variables=variables)
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0], h
+
+
+def test_functional_still_rejected_cases():
+    # multi-output
+    inp = keras.Input((4,))
+    h = keras.layers.Dense(4)(inp)
+    m = keras.Model(inp, [h, keras.layers.Dense(2)(h)])
+    with pytest.raises(NotImplementedError, match="multi-output"):
         from_keras(m)
+    # shared layer (called twice)
+    inp2 = keras.Input((4,))
+    shared = keras.layers.Dense(4, name="shared")
+    out2 = keras.layers.Add()([shared(inp2), shared(inp2)])
+    m2 = keras.Model(inp2, keras.layers.Dense(2)(out2))
+    with pytest.raises(NotImplementedError, match="shared"):
+        from_keras(m2)
+    # multi-input with a non-rank-1 input
+    a = keras.Input((4, 4, 1), name="img")
+    b = keras.Input((3,), name="vec")
+    fa = keras.layers.Flatten()(a)
+    join = keras.layers.Concatenate()([fa, b])
+    m3 = keras.Model([a, b], keras.layers.Dense(2)(join))
+    with pytest.raises(NotImplementedError, match="rank-1"):
+        from_keras(m3)
 
 
 def test_keras2_era_functional_json_parses():
