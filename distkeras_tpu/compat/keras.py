@@ -141,11 +141,11 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
     if class_name in _MERGE_KINDS:
         norm = {"kind": _MERGE_KINDS[class_name]}
         if class_name == "Concatenate":
-            axis = cfg.get("axis", -1)
-            if axis != -1:
-                raise NotImplementedError(
-                    f"Concatenate over axis {axis!r} is not supported; "
-                    f"only the last (feature) axis")
+            # whether a positive axis is "the last axis" depends on the
+            # tensor rank, unknown until apply time — record it and
+            # validate there (axis=1 on rank-2 inputs is the common
+            # Wide&Deep spelling and identical to -1)
+            norm["axis"] = int(cfg.get("axis", -1))
         return norm
     if class_name == "LSTM":
         return _normalize_lstm(cfg, kind="lstm")
@@ -518,8 +518,14 @@ def _apply_layer(layer, name: str, x, dtype, train: bool):
     raise AssertionError(kind)  # unreachable: _normalize_layer gates
 
 
-def _apply_merge(kind: str, ins):
+def _apply_merge(kind: str, ins, layer=None):
     if kind == "merge_concat":
+        axis = int(layer.get("axis", -1)) if layer else -1
+        if axis not in (-1, ins[0].ndim - 1):
+            raise NotImplementedError(
+                f"Concatenate over axis {axis} of rank-{ins[0].ndim} "
+                f"tensors is not supported; only the last (feature) "
+                f"axis")
         return jnp.concatenate(ins, axis=-1)
     if kind == "merge_add":
         out = ins[0]
@@ -587,7 +593,7 @@ class KerasGraph(nn.Module):
                 continue
             ins = [outs[int(i)] for i in node["inputs"]]
             if kind.startswith("merge_"):
-                outs[int(nid)] = _apply_merge(kind, ins)
+                outs[int(nid)] = _apply_merge(kind, ins, node)
             else:
                 outs[int(nid)] = _apply_layer(
                     node, f"layer_{int(node['id'])}", ins[0], dtype,

@@ -1,0 +1,88 @@
+"""TransformerLM training throughput on one chip (PERF.md §13).
+
+The ResNet-50 number is the BASELINE.md flagship; this records the
+transformer side — tokens/sec and analytic MFU for a GPT-2-small-shaped
+``TransformerLM`` — so the long-context family has a measured baseline
+too.  MFU uses the standard 6 * params * tokens training-FLOPs
+estimate (PaLM appendix convention; attention FLOPs reported
+separately), against the chip's bf16 peak.
+
+Usage:  PYTHONPATH=/root/repo python scripts/perf_lm.py
+        [--layers 12 --d-model 768 --seq-len 1024 --batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.profiling import host_sync, peak_flops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+
+    from distkeras_tpu.models import ModelSpec, model_config
+    from distkeras_tpu.workers import (TrainState, make_train_step,
+                                       resolve_optimizer)
+
+    spec = model_config(
+        "transformer_lm", (args.seq_len,), input_dtype="int32",
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        max_len=args.seq_len, dtype="bfloat16")
+    model = ModelSpec.from_config(spec).build()
+    tx = resolve_optimizer("adam", 3e-4)
+    tokens = jnp.zeros((args.batch, args.seq_len), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens[:2])
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    state = TrainState.create(variables, tx, jax.random.key(1))
+    step = jax.jit(make_train_step(
+        model, "sparse_categorical_crossentropy", tx),
+        donate_argnums=0)
+    batch = {"features": tokens, "label": tokens}
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    host_sync(metrics)
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        state, metrics = step(state, batch)
+    val = host_sync(metrics)
+    dt = (time.perf_counter() - t0) / args.reps
+
+    toks = args.batch * args.seq_len
+    # 6ND (fwd 2ND + bwd 4ND) + attention term 12*L*d*T^2 (fwd+bwd)
+    flops_param = 6.0 * n_params * toks
+    flops_attn = (12.0 * args.layers * args.d_model
+                  * args.seq_len * args.seq_len * args.batch)
+    peak, known = peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "model": f"lm L{args.layers} d{args.d_model} T{args.seq_len}",
+        "params_m": round(n_params / 1e6, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "tokens_per_sec": round(toks / dt, 1),
+        "mfu_6nd": (round(flops_param / dt / peak, 4)
+                    if known else None),
+        "mfu_with_attn": (round((flops_param + flops_attn) / dt / peak,
+                                4) if known else None),
+        "loss_finite": bool(np.isfinite(val)),
+    }))
+
+
+if __name__ == "__main__":
+    main()
