@@ -8,7 +8,10 @@ vectorized, static-shape, host-side — feeding device-sharded batches
 """
 
 from distkeras_tpu.data.dataset import Dataset  # noqa: F401
-from distkeras_tpu.data.sharded import ShardedDataset  # noqa: F401
+from distkeras_tpu.data.sharded import (  # noqa: F401
+    CsvShardedDataset,
+    ShardedDataset,
+)
 from distkeras_tpu.data.transformers import (  # noqa: F401
     AssembleTransformer,
     DenseTransformer,

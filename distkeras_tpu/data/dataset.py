@@ -128,6 +128,17 @@ class Dataset:
 
         return from_npz_shards(pattern_or_paths)
 
+    @classmethod
+    def from_csv_shards(cls, pattern_or_paths, *, delimiter: str = ",",
+                        header: bool = True, names=None):
+        """Out-of-core dataset over many delimited text files — the
+        reference's Criteo/ATLAS ingestion shape, streamed one file at
+        a time (``data/sharded.py``)."""
+        from distkeras_tpu.data.sharded import from_csv_shards
+
+        return from_csv_shards(pattern_or_paths, delimiter=delimiter,
+                               header=header, names=names)
+
     def to_npz_shards(self, prefix, rows_per_shard: int) -> list[str]:
         """Write this dataset as ``.npz`` shard files readable by
         ``from_npz_shards``; returns the paths."""

@@ -115,6 +115,15 @@ class ShardedDataset:
             out = out.concat(self.load_shard(i))
         return out
 
+    def map(self, fn) -> "ShardedDataset":
+        """A view whose shards pass through ``fn(Dataset) -> Dataset``
+        at load time — the out-of-core seam for per-shard ETL (e.g. a
+        fitted ``Pipeline.transform`` or an ``AssembleTransformer``,
+        fit on a sample shard).  ``fn`` must preserve the row count:
+        the epoch plan (resume fast-skip, round prediction) is computed
+        from the raw shard metadata."""
+        return _MappedShards(self, fn)
+
     def epoch_segment_loaders(self, seed: int = 0):
         """The epoch plan without the data: yields ``(rows, load)``
         pairs in the seed-permuted shard order, where ``rows`` comes
@@ -144,16 +153,149 @@ class ShardedDataset:
             yield load()
 
 
+class _MappedShards(ShardedDataset):
+    """``ShardedDataset.map``'s view: same shard plan, transformed
+    loads."""
+
+    def __init__(self, base: ShardedDataset, fn):
+        self._base = base
+        self._fn = fn
+        self.paths = list(base.paths)
+        self.shard_rows = list(base.shard_rows)
+        # pre-transform names; the transformed columns exist per loaded
+        # segment (fn may add/drop columns)
+        self._column_names = base.column_names
+
+    def load_shard(self, index: int) -> Dataset:
+        out = self._fn(self._base.load_shard(index))
+        if len(out) != self.shard_rows[index]:
+            raise ValueError(
+                f"map fn changed shard {index}'s row count "
+                f"({self.shard_rows[index]} -> {len(out)}); the epoch "
+                f"plan requires row-preserving transforms")
+        return out
+
+
+class CsvShardedDataset(ShardedDataset):
+    """Out-of-core CSV: a list of delimited text files acting as one
+    logical dataset — the reference's Criteo/ATLAS ingestion shape
+    (Spark read CSVs per partition).  Row counts come from a line scan
+    (no parsing); shard 0 is additionally parsed up front as the
+    schema anchor, so header mismatches, duplicate columns, and
+    non-numeric surprises fail at construction.  Later shards are
+    validated against the anchor at load time: row counts must match
+    the line scan, dtypes must match shard 0 (integer columns are
+    widened to a float anchor automatically; anything else — e.g. a
+    stray non-numeric token turning a column into strings — raises
+    naming the shard and column).
+    """
+
+    def __init__(self, paths: Sequence[str], *, delimiter: str = ",",
+                 header: bool = True,
+                 names: Sequence[str] | None = None):
+        paths = [str(p) for p in paths]
+        if not paths:
+            raise ValueError("CsvShardedDataset needs at least one "
+                             "shard")
+        self.paths = paths
+        self._delimiter = delimiter
+        self._header = header
+        if not header and names is None:
+            raise ValueError("header=False needs explicit names=")
+        self._names = list(names) if names is not None else None
+        self.shard_rows = []
+        first_header: str | None = None
+        for p in paths:
+            rows = 0
+            seen_header = False
+            with open(p) as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    if header and not seen_header:
+                        # header = first NON-BLANK line, matching
+                        # Dataset.from_csv's reader
+                        seen_header = True
+                        if first_header is None:
+                            first_header = line.strip()
+                        elif line.strip() != first_header:
+                            raise ValueError(
+                                f"shard {p} header {line.strip()!r} "
+                                f"differs from {paths[0]}'s "
+                                f"{first_header!r}")
+                        continue
+                    rows += 1
+            if rows == 0:
+                raise ValueError(f"shard {p} has no data rows")
+            self.shard_rows.append(rows)
+        # shard 0 is the schema anchor: parsing it here surfaces
+        # duplicate columns, ragged rows, and the per-column dtypes
+        anchor = self._parse(0)
+        if len(anchor) != self.shard_rows[0]:
+            raise ValueError(
+                f"shard {paths[0]}: line scan found "
+                f"{self.shard_rows[0]} data rows but the parser "
+                f"yielded {len(anchor)}")
+        self._dtypes = {k: v.dtype for k, v in anchor.columns.items()}
+        self._column_names = sorted(anchor.column_names)
+
+    def _parse(self, index: int) -> Dataset:
+        return Dataset.from_csv(self.paths[index],
+                                delimiter=self._delimiter,
+                                header=self._header,
+                                names=self._names)
+
+    def load_shard(self, index: int) -> Dataset:
+        out = self._parse(index)
+        if len(out) != self.shard_rows[index]:
+            raise ValueError(
+                f"shard {self.paths[index]}: line scan found "
+                f"{self.shard_rows[index]} data rows but the parser "
+                f"yielded {len(out)}")
+        cols = out.columns
+        for k, want in self._dtypes.items():
+            got = cols[k].dtype
+            if got == want:
+                continue
+            if np.issubdtype(want, np.floating) \
+                    and np.issubdtype(got, np.integer):
+                # a shard whose values happen to all be
+                # integer-formatted: widen to the float anchor so the
+                # jitted step never retraces on dtype drift
+                cols[k] = cols[k].astype(want)
+                continue
+            raise ValueError(
+                f"shard {self.paths[index]} column {k!r} parsed as "
+                f"{got}, but shard 0 anchors it as {want} (a "
+                f"non-numeric token turns a column into strings; "
+                f"clean the file or pre-bucket it)")
+        return Dataset(cols)
+
+
+def _resolve_paths(pattern_or_paths) -> list[str]:
+    if isinstance(pattern_or_paths, (list, tuple)):
+        return [str(p) for p in pattern_or_paths]
+    paths = sorted(_glob.glob(str(pattern_or_paths)))
+    if not paths:
+        raise ValueError(f"no files match {pattern_or_paths!r}")
+    return paths
+
+
+def from_csv_shards(pattern_or_paths, *, delimiter: str = ",",
+                    header: bool = True,
+                    names: Sequence[str] | None = None
+                    ) -> CsvShardedDataset:
+    """``Dataset.from_csv_shards``: out-of-core dataset over delimited
+    text files (glob pattern, sorted, or explicit path list)."""
+    return CsvShardedDataset(_resolve_paths(pattern_or_paths),
+                             delimiter=delimiter, header=header,
+                             names=names)
+
+
 def from_npz_shards(pattern_or_paths) -> ShardedDataset:
     """``Dataset.from_npz_shards``: build a ShardedDataset from a glob
     pattern (sorted) or an explicit path list."""
-    if isinstance(pattern_or_paths, (list, tuple)):
-        return ShardedDataset(pattern_or_paths)
-    paths = sorted(_glob.glob(str(pattern_or_paths)))
-    if not paths:
-        raise ValueError(
-            f"no files match {pattern_or_paths!r}")
-    return ShardedDataset(paths)
+    return ShardedDataset(_resolve_paths(pattern_or_paths))
 
 
 def to_npz_shards(dataset: Dataset, prefix: str,

@@ -205,6 +205,86 @@ def test_checkpoint_at_segment_boundary_fires_mid_epoch(tmp_path):
         np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
 
 
+def _write_csv_shards(tmp_path, shards=3, rows_per=128, seed=0):
+    """Criteo-ish delimited shards: numeric columns + int label."""
+    rng = np.random.default_rng(seed)
+    paths = []
+    for s in range(shards):
+        p = tmp_path / f"data-{s:03d}.csv"
+        with open(p, "w") as fh:
+            fh.write("f0,f1,f2,label\n")
+            labels = rng.integers(0, 3, size=rows_per)
+            feats = rng.normal(size=(rows_per, 3)) + labels[:, None]
+            for row, y in zip(feats, labels):
+                fh.write(",".join(f"{v:.5f}" for v in row)
+                         + f",{y}\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_csv_shards_metadata_and_streaming(tmp_path):
+    paths = _write_csv_shards(tmp_path)
+    sd = Dataset.from_csv_shards(str(tmp_path / "data-*.csv"))
+    assert sd.num_shards == 3 and len(sd) == 384
+    assert sd.column_names == ["f0", "f1", "f2", "label"]
+    assert sd.shard_rows == [128, 128, 128]
+    seg = next(iter(sd.epoch_segments(seed=0)))
+    assert seg["label"].dtype == np.int64
+    assert seg["f0"].dtype == np.float32
+
+
+def test_csv_shards_train_through_etl_map(tmp_path):
+    """The Criteo workflow out-of-core: CSV shards -> per-shard
+    Assemble transform -> async PS trainer."""
+    from distkeras_tpu.data import AssembleTransformer
+
+    paths = _write_csv_shards(tmp_path, shards=4, rows_per=256)
+    sd = Dataset.from_csv_shards(paths)
+    assemble = AssembleTransformer(["f0", "f1", "f2"])
+    cfg = model_config("mlp", (3,), num_classes=3, hidden=(16,))
+    t = ADAG(cfg, num_workers=4, communication_window=2, batch_size=8,
+             num_epoch=3, learning_rate=0.05, seed=0)
+    t.train(sd.map(assemble.transform))
+    h = t.history["epoch_loss"]
+    assert h[-1] < h[0] * 0.8, h
+
+
+def test_csv_shard_dtype_anchor(tmp_path):
+    """Shard 0 anchors the schema: integer-looking shards widen to a
+    float anchor (no jit retrace on dtype drift); a non-numeric token
+    raises naming the shard and column; a leading blank line doesn't
+    desync the header scan."""
+    (tmp_path / "s-0.csv").write_text(
+        "\nx,label\n1.5,0\n2.5,1\n")  # blank first line + floats
+    (tmp_path / "s-1.csv").write_text("x,label\n1,0\n2,1\n")  # ints
+    sd = Dataset.from_csv_shards(str(tmp_path / "s-*.csv"))
+    assert sd.shard_rows == [2, 2]
+    assert sd.load_shard(1)["x"].dtype == np.float32  # widened
+    (tmp_path / "s-2.csv").write_text("x,label\nNA,0\n2,1\n")
+    sd2 = Dataset.from_csv_shards(str(tmp_path / "s-*.csv"))
+    with pytest.raises(ValueError, match="s-2.*'x'|'x'.*s-2"):
+        sd2.load_shard(2)
+    # duplicate header columns fail at construction (anchor parse)
+    (tmp_path / "d-0.csv").write_text("a,a\n1,2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        Dataset.from_csv_shards(str(tmp_path / "d-0.csv"))
+
+
+def test_csv_shard_guards(tmp_path):
+    _write_csv_shards(tmp_path)
+    # mismatched header across shards fails at construction
+    bad = tmp_path / "data-999.csv"
+    bad.write_text("a,b\n1,2\n")
+    with pytest.raises(ValueError, match="header"):
+        Dataset.from_csv_shards(str(tmp_path / "data-*.csv"))
+    bad.unlink()
+    # a row-count-changing map fn fails loudly at load
+    sd = Dataset.from_csv_shards(str(tmp_path / "data-*.csv"))
+    clipped = sd.map(lambda ds: ds.take(5))
+    with pytest.raises(ValueError, match="row count"):
+        clipped.load_shard(0)
+
+
 def test_sharded_guards(tmp_path):
     full, paths = _make(tmp_path)
     with pytest.raises(ValueError, match="no files match"):
