@@ -264,11 +264,17 @@ class CsvShardedDataset(ShardedDataset):
                 # jitted step never retraces on dtype drift
                 cols[k] = cols[k].astype(want)
                 continue
+            if got.kind == want.kind:
+                # same kind, different width — e.g. string columns
+                # whose longest token differs per shard (<U2 vs <U5),
+                # the normal categorical shape; transformers hash or
+                # index them per value, width is irrelevant
+                continue
             raise ValueError(
                 f"shard {self.paths[index]} column {k!r} parsed as "
                 f"{got}, but shard 0 anchors it as {want} (a "
-                f"non-numeric token turns a column into strings; "
-                f"clean the file or pre-bucket it)")
+                f"non-numeric token turns a numeric column into "
+                f"strings; clean the file or pre-bucket it)")
         return Dataset(cols)
 
 

@@ -19,8 +19,13 @@ Two transports:
   one host, threads driving device steps);
 * socket — a TCP server thread speaking the L1 framing
   (``parallel.transport``): single-byte commands ``b"p"`` (pull) /
-  ``b"c"`` (commit payload) / ``b"s"`` (stop), msgpack parameter
-  payloads.  The reference's wire protocol, minus pickle.
+  ``b"c"`` (commit payload) / ``b"s"`` (stop).  Raw parameter
+  payloads ride ``pack_params``'s template-implied encoding
+  (concatenated leaf bytes in canonical pytree order — both endpoints
+  hold the same template, so the wire carries only data; ~10x faster
+  than the earlier msgpack encoding at ResNet scale, PERF.md §12);
+  compressed commits ride the negotiated codec's bytes.  The
+  reference's wire protocol, minus pickle.
 """
 
 from __future__ import annotations
@@ -35,7 +40,48 @@ import numpy as np
 
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
-from distkeras_tpu.utils import deserialize_params, serialize_params
+def pack_params(tree, template=None) -> bytes:
+    """Raw-buffer wire encoding: leaves in canonical pytree order,
+    concatenated ``tobytes()``.  Shapes/dtypes ride the TEMPLATE both
+    endpoints already hold (PSServer and PSClient are constructed with
+    the same center tree), so the wire carries only data — measured
+    ~10x faster than the msgpack path at ResNet-18 scale (45 MB:
+    ~13 ms pack vs 132 ms serialize; unpack is zero-copy views vs
+    47 ms), which matters because serialization IS the PS ceiling
+    (PERF.md §12).  ``template`` casts each leaf to the wire dtype
+    (the msgpack path did the cast on the receive side; e.g. a worker
+    computing f64 deltas against an f32 center)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if template is not None:
+        temps = jax.tree_util.tree_leaves(template)
+        if len(temps) != len(leaves):
+            raise ValueError(
+                f"payload has {len(leaves)} leaves, template "
+                f"{len(temps)}")
+        leaves = [np.asarray(x, dtype=t.dtype)
+                  for x, t in zip(leaves, temps)]
+    return b"".join(
+        np.ascontiguousarray(x).tobytes() for x in leaves)
+
+
+def unpack_params(template, data: bytes):
+    """Inverse of ``pack_params``: zero-copy ``frombuffer`` views
+    sliced per the template's leaf shapes/dtypes (read-only arrays —
+    every consumer treats pulled/committed trees as immutable)."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    buf = memoryview(data)
+    out, off = [], 0
+    for t in leaves:
+        n = int(t.nbytes)
+        arr = np.frombuffer(buf[off:off + n],
+                            dtype=t.dtype).reshape(t.shape)
+        out.append(arr)
+        off += n
+    if off != len(data):
+        raise ValueError(
+            f"wire payload is {len(data)} bytes but the template "
+            f"expects {off} (mismatched model between worker and PS)")
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 Pytree = Any
 
@@ -229,7 +275,7 @@ class PSServer:
                     msg = transport.recv_msg(conn)
                     cmd, body = msg[:1], msg[1:]
                     if cmd == b"p":
-                        transport.send_msg(conn, serialize_params(
+                        transport.send_msg(conn, pack_params(
                             self.ps.pull(worker_id)))
                     elif cmd == b"c":
                         seq = int.from_bytes(body[:8], "big")
@@ -239,17 +285,17 @@ class PSServer:
                             payload = codec.decode(body[8:],
                                                    self._template)
                         else:
-                            payload = deserialize_params(
+                            payload = unpack_params(
                                 self._template, body[8:])
                         local = None
                         if self.ps.rule.pull_uses_local:
-                            local = deserialize_params(
+                            local = unpack_params(
                                 self._template,
                                 transport.recv_msg(conn))
                         pulled = self.ps.commit(worker_id, payload,
                                                 local, seq=seq)
                         transport.send_msg(conn,
-                                           serialize_params(pulled))
+                                           pack_params(pulled))
                     elif cmd == b"d":
                         # clean worker finish: retire from liveness
                         # monitoring and drop its dedupe reply
@@ -326,8 +372,8 @@ class PSClient:
 
     def pull(self) -> Pytree:
         transport.send_msg(self._sock, b"p")
-        return deserialize_params(self._template,
-                                  transport.recv_msg(self._sock))
+        return unpack_params(self._template,
+                             transport.recv_msg(self._sock))
 
     def commit(self, payload: Pytree, local: Pytree | None = None,
                seq: int | None = None) -> Pytree:
@@ -351,14 +397,15 @@ class PSClient:
             # bytes, keeping the residual
             body = self.codec.encode(payload)
         else:
-            body = serialize_params(_to_numpy(payload))
+            body = pack_params(_to_numpy(payload), self._template)
         transport.send_msg(self._sock,
                            b"c" + wire_seq.to_bytes(8, "big"), body)
         if local is not None:
             transport.send_msg(self._sock,
-                               serialize_params(_to_numpy(local)))
-        return deserialize_params(self._template,
-                                  transport.recv_msg(self._sock))
+                               pack_params(_to_numpy(local),
+                                           self._template))
+        return unpack_params(self._template,
+                             transport.recv_msg(self._sock))
 
     def done(self):
         """Announce a clean finish (retires this worker from the

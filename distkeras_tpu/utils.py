@@ -30,12 +30,41 @@ Pytree = Any
 # ---------------------------------------------------------------------------
 
 
+def _host_leaf(x) -> bool:
+    """True when ``x`` lives on the host as a plain numpy array (no
+    tracer, no device array, no python scalar).  The host PS path runs
+    these tree ops eagerly at ResNet scale, where per-leaf jax dispatch
+    costs 100-300 ms/op on this runtime vs <1 ms in numpy (measured:
+    62-leaf tree_add 5.7 s via jnp, 32 ms via np — PERF.md §12); numpy
+    also keeps the server thread off the device entirely.  Everything
+    else keeps the jnp path, so jitted update rules (and the legacy
+    promotion semantics for scalars/int leaves) are untouched."""
+    return isinstance(x, np.ndarray)
+
+
+def _float_host(x) -> bool:
+    """Host numpy leaf with a float dtype — the only leaves the
+    scaled ops (axpy/lerp) take the numpy path for: a leaf-dtype
+    scalar coefficient on an INT leaf would truncate (int32(0.5) == 0)
+    where the jnp path promotes to float."""
+    return isinstance(x, np.ndarray) and x.dtype.kind == "f"
+
+
+def _binary(np_op, jnp_op):
+    def op(x, y):
+        if _host_leaf(x) and _host_leaf(y):
+            return np_op(x, y)
+        return jnp_op(x, y)
+    return op
+
+
 def tree_add(a: Pytree, b: Pytree) -> Pytree:
-    return jax.tree_util.tree_map(jnp.add, a, b)
+    return jax.tree_util.tree_map(_binary(np.add, jnp.add), a, b)
 
 
 def tree_sub(a: Pytree, b: Pytree) -> Pytree:
-    return jax.tree_util.tree_map(jnp.subtract, a, b)
+    return jax.tree_util.tree_map(_binary(np.subtract, jnp.subtract),
+                                  a, b)
 
 
 def tree_scale(a: Pytree, s) -> Pytree:
@@ -43,17 +72,30 @@ def tree_scale(a: Pytree, s) -> Pytree:
 
 
 def tree_zeros_like(a: Pytree) -> Pytree:
-    return jax.tree_util.tree_map(jnp.zeros_like, a)
+    return jax.tree_util.tree_map(
+        lambda x: (np.zeros_like(x) if _host_leaf(x)
+                   else jnp.zeros_like(x)), a)
 
 
 def tree_axpy(alpha, x: Pytree, y: Pytree) -> Pytree:
     """alpha * x + y, elementwise over matching pytrees."""
-    return jax.tree_util.tree_map(lambda xi, yi: alpha * xi + yi, x, y)
+    # numpy path (float leaves only): a leaf-dtype scalar keeps f32
+    # leaves f32 (a bare np.asarray(alpha) would be f64 and promote
+    # the whole tree)
+    def op(xi, yi):
+        if _float_host(xi) and _float_host(yi):
+            return xi.dtype.type(alpha) * xi + yi
+        return alpha * xi + yi
+    return jax.tree_util.tree_map(op, x, y)
 
 
 def tree_lerp(a: Pytree, b: Pytree, t) -> Pytree:
     """(1 - t) * a + t * b."""
-    return jax.tree_util.tree_map(lambda ai, bi: (1.0 - t) * ai + t * bi, a, b)
+    def op(ai, bi):
+        if _float_host(ai) and _float_host(bi):
+            return ai.dtype.type(1.0 - t) * ai + ai.dtype.type(t) * bi
+        return (1.0 - t) * ai + t * bi
+    return jax.tree_util.tree_map(op, a, b)
 
 
 def tree_dot(a: Pytree, b: Pytree):

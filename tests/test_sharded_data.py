@@ -264,6 +264,12 @@ def test_csv_shard_dtype_anchor(tmp_path):
     sd2 = Dataset.from_csv_shards(str(tmp_path / "s-*.csv"))
     with pytest.raises(ValueError, match="s-2.*'x'|'x'.*s-2"):
         sd2.load_shard(2)
+    # string columns with different max widths across shards are the
+    # normal categorical shape, not dtype drift
+    (tmp_path / "c-0.csv").write_text("cat,label\nab,0\ncd,1\n")
+    (tmp_path / "c-1.csv").write_text("cat,label\nabcde,0\nx,1\n")
+    sdc = Dataset.from_csv_shards(str(tmp_path / "c-*.csv"))
+    assert sdc.load_shard(1)["cat"].dtype.kind == "U"
     # duplicate header columns fail at construction (anchor parse)
     (tmp_path / "d-0.csv").write_text("a,a\n1,2\n")
     with pytest.raises(ValueError, match="duplicate"):

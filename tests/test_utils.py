@@ -64,3 +64,29 @@ def test_batch_iterator_and_padding():
     assert len(batches) == 2 and batches[1]["x"][0] == 4
     padded = utils.pad_to_multiple(np.ones((10, 3)), 8)
     assert padded.shape == (16, 3)
+
+
+def test_tree_ops_numpy_fast_path_semantics():
+    """The numpy fast path (host PS apply, PERF.md §12) must preserve
+    the jnp path's semantics: float leaves stay their dtype, int and
+    python-scalar leaves keep the promoting jnp behavior (a leaf-dtype
+    scalar would truncate int32(0.5) -> 0)."""
+    import numpy as np
+
+    f32 = {"a": np.full((4,), 2.0, np.float32)}
+    out = utils.tree_add(f32, f32)
+    assert isinstance(out["a"], np.ndarray)
+    assert out["a"].dtype == np.float32
+    out = utils.tree_lerp(f32, {"a": np.full((4,), 4.0, np.float32)},
+                          0.5)
+    assert out["a"].dtype == np.float32
+    np.testing.assert_allclose(out["a"], 3.0)
+    # int leaves: promote like jnp, never truncate the coefficient
+    ints = {"a": np.array([10, 10])}
+    out = utils.tree_lerp(ints, {"a": np.array([20, 20])}, 0.5)
+    np.testing.assert_allclose(np.asarray(out["a"]), 15.0)
+    out = utils.tree_axpy(0.5, ints, {"a": np.array([1, 1])})
+    np.testing.assert_allclose(np.asarray(out["a"]), 6.0)
+    # python scalar leaves still work (jnp path)
+    out = utils.tree_lerp({"a": 1.0}, {"a": 3.0}, 0.5)
+    np.testing.assert_allclose(float(out["a"]), 2.0)
