@@ -40,6 +40,11 @@ import numpy as np
 
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
+
+def _to_numpy(tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
 def pack_params(tree, template=None) -> bytes:
     """Raw-buffer wire encoding: leaves in canonical pytree order,
     concatenated ``tobytes()``.  Shapes/dtypes ride the TEMPLATE both
@@ -89,9 +94,6 @@ Pytree = Any
 _NO_SEQ = 2 ** 64 - 1
 
 
-def _to_numpy(tree: Pytree) -> Pytree:
-    return jax.tree_util.tree_map(np.asarray, tree)
-
 
 class HostParameterServer:
     """Threaded central state: ``pull``/``commit`` under a mutex.
@@ -139,6 +141,13 @@ class HostParameterServer:
         old retransmit arbitrarily late); stragglers older than the
         last commit get the cached latest reply, which lands on a dead
         connection anyway."""
+        # normalize to host numpy up front: the in-process arm hands
+        # jax arrays straight in, which would silently push the apply
+        # back onto the eager per-leaf jnp path the numpy fast path
+        # exists to avoid (PERF.md §12)
+        payload = _to_numpy(payload)
+        if local is not None:
+            local = _to_numpy(local)
         with self._lock:
             if seq is not None:
                 last = self._last_reply.get(worker_id)
@@ -217,8 +226,8 @@ class PSServer:
                  host: str = "127.0.0.1", port: int = 0):
         """The handshake frame is ``4-byte worker id`` optionally
         followed by a codec name (``parallel.compression``): commits on
-        that connection then arrive codec-encoded instead of as raw
-        msgpack params — the wire-compression arm."""
+        that connection then arrive codec-encoded instead of via the
+        raw template-implied ``pack_params`` encoding — the wire-compression arm."""
         self.ps = ps
         self._template = _to_numpy(template)
         self._sock = socket.socket()
@@ -276,7 +285,7 @@ class PSServer:
                     cmd, body = msg[:1], msg[1:]
                     if cmd == b"p":
                         transport.send_msg(conn, pack_params(
-                            self.ps.pull(worker_id)))
+                            self.ps.pull(worker_id), self._template))
                     elif cmd == b"c":
                         seq = int.from_bytes(body[:8], "big")
                         if seq == _NO_SEQ:
@@ -295,7 +304,8 @@ class PSServer:
                         pulled = self.ps.commit(worker_id, payload,
                                                 local, seq=seq)
                         transport.send_msg(conn,
-                                           pack_params(pulled))
+                                           pack_params(
+                                               pulled, self._template))
                     elif cmd == b"d":
                         # clean worker finish: retire from liveness
                         # monitoring and drop its dedupe reply

@@ -5,7 +5,8 @@ L1, §2.4): ``connect`` / ``send_msg`` / ``recv_msg`` with a fixed 8-byte
 big-endian length header and a ``recvall`` loop, plus
 ``determine_host_address``.  Two deliberate departures from the
 reference: payloads are msgpack maps of raw tensor bytes
-(``utils.serialize_params``), never pickle (no arbitrary-object
+(``host_ps.pack_params``'s template-implied raw encoding for
+parameters, msgpack elsewhere), never pickle (no arbitrary-object
 execution on receive), and Nagle is disabled on both ends (the PS
 exchange is latency-bound request/response traffic).
 """

@@ -1016,7 +1016,6 @@ class DistributedTrainer(Trainer):
         reduced so every process returns identical results."""
         import threading
 
-        from distkeras_tpu.data.sharded import ShardedDataset
         from distkeras_tpu.parallel.compression import (raw_nbytes,
                                                         resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
@@ -1024,12 +1023,6 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu.utils import (tree_add, tree_sub,
                                          tree_zeros_like)
 
-        if isinstance(dataset, ShardedDataset):
-            raise NotImplementedError(
-                "fidelity='host' stacks each worker's whole epoch in "
-                "its thread and does not stream shard files; "
-                "materialize with .to_dataset() if it fits, or use the "
-                "emulated fidelities for out-of-core data")
         rule = self.allocate_rule()
         codec = resolve_codec(self.compression)
         if codec is not None and rule.payload_kind != "delta":
@@ -1118,8 +1111,15 @@ class DistributedTrainer(Trainer):
         # reach epoch e builds the shards once (not one full-dataset
         # copy per thread); entries are dropped after the last worker
         # fetches them.
-        shard_lock = threading.Lock()
-        shard_cache: dict[int, tuple[list, set]] = {}
+        # RLock: segment_shard -> epoch_plan nests the acquisition
+        shard_lock = threading.RLock()
+        # keyed (epoch, segment slot): one segment for in-memory
+        # datasets (the whole shuffled set), one per shard file for
+        # ShardedDataset — the host arm streams out-of-core data the
+        # same way the emulated arms do, with peak memory bounded by
+        # the segments concurrently in flight across threads
+        shard_cache: dict[tuple[int, int], tuple[list | None, set]] = {}
+        plan_cache: dict[int, list] = {}
         per_proc = num_workers // pc
         local_workers = (range(rank * per_proc, (rank + 1) * per_proc)
                          if multi else range(num_workers))
@@ -1129,28 +1129,60 @@ class DistributedTrainer(Trainer):
         dead_workers: set[int] = (set(range(num_workers))
                                   - set(local_workers))
         dropped_per_epoch = [0] * self.num_epoch
+        skipped_rows_per_epoch = [0] * self.num_epoch
 
         def _sweep_shard_cache():
-            # caller holds shard_lock: drop entries every live worker
-            # has fetched (dead workers never will — without this, each
-            # dead worker would pin one full dataset copy per epoch)
-            for e in [e for e, (_, fetched) in shard_cache.items()
-                      if fetched | dead_workers
+            # caller holds shard_lock: drop READY entries every live
+            # worker has fetched (dead workers never will — without
+            # this, each dead worker would pin one segment per slot)
+            for e in [e for e, (_, fetched, _, ready)
+                      in shard_cache.items()
+                      if ready and fetched | dead_workers
                       >= set(range(num_workers))]:
                 del shard_cache[e]
 
-        def epoch_shard(epoch: int, w: int):
+        def epoch_plan(epoch: int) -> list:
+            # (rows, load) pairs, deterministic in the epoch seed —
+            # every worker walks the same segment order
             with shard_lock:
-                if epoch not in shard_cache:
-                    shard_cache[epoch] = (
-                        dataset.shuffle(
-                            seed=self.seed + 17 * epoch
-                        ).repartition(num_workers), set())
-                shards, fetched = shard_cache[epoch]
-                shard = shards[w]
-                fetched.add(w)
-                _sweep_shard_cache()
-                return shard
+                if epoch not in plan_cache:
+                    plan_cache[epoch] = list(_epoch_segment_loaders(
+                        dataset, self.seed + 17 * epoch))
+                return plan_cache[epoch]
+
+        def segment_shard(epoch: int, slot: int, w: int):
+            """Worker ``w``'s slice of segment ``slot``; None when the
+            segment cannot give every worker a row.  The segment is
+            built (loaded / shuffled / repartitioned) OUTSIDE the lock
+            by the first requester — other workers wait on its event,
+            and requesters of cached or different segments never block
+            behind the IO."""
+            key = (epoch, slot)
+            while True:
+                build = False
+                with shard_lock:
+                    entry = shard_cache.get(key)
+                    if entry is None:
+                        event = threading.Event()
+                        shard_cache[key] = (None, set(), event, False)
+                        build = True
+                    else:
+                        shards, fetched, event, ready = entry
+                        if ready:
+                            shard = (None if shards is None
+                                     else shards[w])
+                            fetched.add(w)
+                            _sweep_shard_cache()
+                            return shard
+                if build:
+                    rows, load = epoch_plan(epoch)[slot]
+                    shards = (load().repartition(num_workers)
+                              if rows >= num_workers else None)
+                    with shard_lock:
+                        shard_cache[key] = (shards, set(), event, True)
+                    event.set()
+                else:
+                    event.wait()
 
         def note_death(w: int):
             with shard_lock:
@@ -1194,120 +1226,137 @@ class DistributedTrainer(Trainer):
                         with history_lock:
                             retry_records.append((w, -1, -1))
                 for epoch in range(self.num_epoch):
-                    stacked = _stack_batches(epoch_shard(epoch, w),
-                                             self.batch_size, cols)
-                    if stacked is None:
-                        raise ValueError(
-                            f"worker {w} shard smaller than one batch")
-                    n_batches = len(next(iter(stacked.values())))
-                    n_rounds = n_batches // window
-                    if n_rounds == 0:
-                        raise ValueError(
-                            f"not enough batches per worker "
-                            f"({n_batches}) for one communication "
-                            f"window ({window})")
-                    with history_lock:
-                        dropped_per_epoch[epoch] += (
-                            n_batches - n_rounds * window)
-                    for r in range(n_rounds):
-                        batches = {
-                            k: jnp.asarray(
-                                v[r * window:(r + 1) * window])
-                            for k, v in stacked.items()}
-                        attempts = 0
-                        reconnect = False
-                        # (bytes, applied, total, raw_nbytes) cached
-                        # across retry attempts of this commit_seq
-                        pending_commit = None
-                        base_state = state  # pre-round snapshot: a
-                        # retried window must not see optimizer
-                        # moments / rng / step already advanced by the
-                        # aborted attempt
-                        while True:
-                            try:
-                                if reconnect:
-                                    # inside the try: a PS still
-                                    # unreachable during recovery must
-                                    # consume retry budget, not kill
-                                    # the worker outright
-                                    if client is not None:
-                                        client.close()
-                                    pull, commit = connect()
-                                    pulled = pull()
-                                    reconnect = False
-                                if self.fault_injector is not None:
-                                    self.fault_injector(w, epoch, r)
-                                if pending_commit is None:
-                                    start_params = (
-                                        jax.tree_util.tree_map(
-                                            jnp.asarray, pulled))
-                                    state = base_state.replace(
-                                        params=start_params)
-                                    state, metrics = run_window(
-                                        state, batches)
-                                    if rule.payload_kind == "params":
-                                        payload = local = state.params
-                                    else:
-                                        payload = rule.normalize_delta(
-                                            tree_sub(state.params,
-                                                     start_params),
-                                            window)
-                                        local = None
-                                    if codec is not None:
-                                        # Error feedback: fold the
-                                        # residual under-transmitted so
-                                        # far into this window's delta;
-                                        # cache the encoding per
-                                        # commit_seq.
-                                        total = tree_add(payload,
-                                                         residual)
-                                        pending_commit = (
-                                            *codec.round_trip(total),
-                                            total, raw_nbytes(payload))
-                                # A retry with a cached encoding skips
-                                # the window recompute and resends the
-                                # IDENTICAL bytes: the server may have
-                                # applied them and lost only the ack
-                                # (seq dedupe returns the cached
-                                # reply), so the residual below always
-                                # matches what the server absorbed.
-                                if codec is not None:
-                                    encoded, applied, total, raw_n = (
-                                        pending_commit)
-                                    pulled = commit(
-                                        encoded if client is not None
-                                        else applied,
-                                        None, seq=commit_seq)
-                                    residual = tree_sub(total, applied)
-                                    pending_commit = None
-                                    wire_bytes += len(encoded)
-                                    raw_bytes += raw_n
-                                else:
-                                    pulled = commit(
-                                        payload,
-                                        local if rule.pull_uses_local
-                                        else None, seq=commit_seq)
-                                commit_seq += 1
-                                break
-                            except Exception:
-                                # At-most-once retry: an uncommitted
-                                # window's delta never reached the PS;
-                                # one whose *ack* was lost is deduped
-                                # server-side by commit_seq.
-                                # (Exception, not BaseException:
-                                # KeyboardInterrupt/MemoryError should
-                                # not be retried.)
-                                attempts += 1
-                                if attempts > self.worker_retries:
-                                    raise
-                                reconnect = True
-                                with history_lock:
-                                    retry_records.append((w, epoch, r))
+                    epoch_rounds = 0  # global round id across segments
+                    for slot in range(len(epoch_plan(epoch))):
+                        shard = segment_shard(epoch, slot, w)
+                        stacked = (None if shard is None else
+                                   _stack_batches(shard,
+                                                  self.batch_size,
+                                                  cols))
+                        if stacked is None:
+                            # segment too small for this worker's
+                            # batch: its rows never train — recorded,
+                            # never silent (this worker's nominal
+                            # slice; summed over workers ~= the
+                            # segment)
+                            rows = epoch_plan(epoch)[slot][0]
+                            with history_lock:
+                                skipped_rows_per_epoch[epoch] += (
+                                    len(shard) if shard is not None
+                                    else rows // num_workers)
+                            continue
+                        n_batches = len(next(iter(stacked.values())))
+                        seg_rounds = n_batches // window
                         with history_lock:
-                            round_records.append(
-                                (w, epoch,
-                                 float(np.mean(
-                                     np.asarray(metrics["loss"])))))
+                            dropped_per_epoch[epoch] += (
+                                n_batches - seg_rounds * window)
+                        for r_local in range(seg_rounds):
+                            r = epoch_rounds
+                            epoch_rounds += 1
+                            batches = {
+                                k: jnp.asarray(
+                                    v[r_local * window:
+                                      (r_local + 1) * window])
+                                for k, v in stacked.items()}
+                            attempts = 0
+                            reconnect = False
+                            # (bytes, applied, total, raw_nbytes) cached
+                            # across retry attempts of this commit_seq
+                            pending_commit = None
+                            base_state = state  # pre-round snapshot: a
+                            # retried window must not see optimizer
+                            # moments / rng / step already advanced by the
+                            # aborted attempt
+                            while True:
+                                try:
+                                    if reconnect:
+                                        # inside the try: a PS still
+                                        # unreachable during recovery must
+                                        # consume retry budget, not kill
+                                        # the worker outright
+                                        if client is not None:
+                                            client.close()
+                                        pull, commit = connect()
+                                        pulled = pull()
+                                        reconnect = False
+                                    if self.fault_injector is not None:
+                                        self.fault_injector(w, epoch, r)
+                                    if pending_commit is None:
+                                        start_params = (
+                                            jax.tree_util.tree_map(
+                                                jnp.asarray, pulled))
+                                        state = base_state.replace(
+                                            params=start_params)
+                                        state, metrics = run_window(
+                                            state, batches)
+                                        if rule.payload_kind == "params":
+                                            payload = local = state.params
+                                        else:
+                                            payload = rule.normalize_delta(
+                                                tree_sub(state.params,
+                                                         start_params),
+                                                window)
+                                            local = None
+                                        if codec is not None:
+                                            # Error feedback: fold the
+                                            # residual under-transmitted so
+                                            # far into this window's delta;
+                                            # cache the encoding per
+                                            # commit_seq.
+                                            total = tree_add(payload,
+                                                             residual)
+                                            pending_commit = (
+                                                *codec.round_trip(total),
+                                                total, raw_nbytes(payload))
+                                    # A retry with a cached encoding skips
+                                    # the window recompute and resends the
+                                    # IDENTICAL bytes: the server may have
+                                    # applied them and lost only the ack
+                                    # (seq dedupe returns the cached
+                                    # reply), so the residual below always
+                                    # matches what the server absorbed.
+                                    if codec is not None:
+                                        encoded, applied, total, raw_n = (
+                                            pending_commit)
+                                        pulled = commit(
+                                            encoded if client is not None
+                                            else applied,
+                                            None, seq=commit_seq)
+                                        residual = tree_sub(total, applied)
+                                        pending_commit = None
+                                        wire_bytes += len(encoded)
+                                        raw_bytes += raw_n
+                                    else:
+                                        pulled = commit(
+                                            payload,
+                                            local if rule.pull_uses_local
+                                            else None, seq=commit_seq)
+                                    commit_seq += 1
+                                    break
+                                except Exception:
+                                    # At-most-once retry: an uncommitted
+                                    # window's delta never reached the PS;
+                                    # one whose *ack* was lost is deduped
+                                    # server-side by commit_seq.
+                                    # (Exception, not BaseException:
+                                    # KeyboardInterrupt/MemoryError should
+                                    # not be retried.)
+                                    attempts += 1
+                                    if attempts > self.worker_retries:
+                                        raise
+                                    reconnect = True
+                                    with history_lock:
+                                        retry_records.append((w, epoch, r))
+                            with history_lock:
+                                round_records.append(
+                                    (w, epoch,
+                                     float(np.mean(
+                                         np.asarray(metrics["loss"])))))
+                    if epoch_rounds == 0:
+                        raise ValueError(
+                            f"worker {w}: not enough batches per "
+                            f"worker for one communication window "
+                            f"({window}) in any segment")
                 if client is not None:
                     client.done()
                     client.close()
@@ -1398,10 +1447,11 @@ class DistributedTrainer(Trainer):
         # process reports identical curves.
         for _, _, loss in round_records:
             self._record(round_loss=loss)
-        sums = np.zeros((self.num_epoch, 3))
+        sums = np.zeros((self.num_epoch, 4))
         for _, e, loss in round_records:
-            sums[e] += (loss, 1.0, 0.0)
+            sums[e] += (loss, 1.0, 0.0, 0.0)
         sums[:, 2] = dropped_per_epoch
+        sums[:, 3] = skipped_rows_per_epoch
         if multi:
             sums = np.asarray(
                 multihost_utils.process_allgather(sums)).sum(axis=0)
@@ -1410,6 +1460,9 @@ class DistributedTrainer(Trainer):
                 epoch_loss=float(sums[epoch, 0]
                                  / max(sums[epoch, 1], 1.0)),
                 dropped_tail_batches=int(sums[epoch, 2]))
+            if sums[epoch, 3]:
+                self._record(
+                    skipped_segment_rows=int(sums[epoch, 3]))
 
         if multi:
             # staleness log + final center live on process 0; broadcast
