@@ -134,12 +134,20 @@ class ShardedDataset:
         order = (rng.permutation(self.num_shards)
                  if self.num_shards > 1 else [0])
         for slot, i in enumerate(order):
-            # per-shard salt keeps distinct shards from sharing a
-            # permutation; shard count 1 must keep the plain seed for
-            # the bit-identity contract
-            salt = 0 if self.num_shards == 1 else 1000003 * (slot + 1) + i
+            # per-shard seed keeps distinct shards from sharing a
+            # permutation; hashing (seed, slot, shard) through
+            # SeedSequence avoids the additive-salt collisions where
+            # nearby epoch seeds alias across (slot, shard) pairs.
+            # Shard count 1 must keep the plain seed for the
+            # bit-identity contract with the in-memory path.
+            if self.num_shards == 1:
+                s = seed
+            else:
+                s = int(np.random.SeedSequence(
+                    [seed % (1 << 63), slot, int(i)]
+                ).generate_state(1, dtype=np.uint64)[0])
             yield (int(self.shard_rows[int(i)]),
-                   lambda idx=int(i), s=seed + salt:
+                   lambda idx=int(i), s=s:
                    self.load_shard(idx).shuffle(seed=s))
 
     def epoch_segments(self, seed: int = 0) -> Iterator[Dataset]:
@@ -265,10 +273,24 @@ class CsvShardedDataset(ShardedDataset):
                 cols[k] = cols[k].astype(want)
                 continue
             if got.kind == want.kind:
-                # same kind, different width — e.g. string columns
-                # whose longest token differs per shard (<U2 vs <U5),
-                # the normal categorical shape; transformers hash or
-                # index them per value, width is irrelevant
+                if want.kind in "USO":
+                    # string columns whose longest token differs per
+                    # shard (<U2 vs <U5), the normal categorical
+                    # shape; transformers hash or index per value,
+                    # width is irrelevant
+                    continue
+                # same-kind numeric width drift (int32 vs an int64
+                # anchor, say) would retrace the jitted step per
+                # shard — cast to the anchor, but never silently: a
+                # narrowing cast that changes any value is data
+                # corruption, not schema alignment
+                cast = cols[k].astype(want)
+                if not np.array_equal(cast.astype(got), cols[k]):
+                    raise ValueError(
+                        f"shard {self.paths[index]} column {k!r} "
+                        f"parsed as {got} with values that do not fit "
+                        f"shard 0's anchor dtype {want}")
+                cols[k] = cast
                 continue
             raise ValueError(
                 f"shard {self.paths[index]} column {k!r} parsed as "

@@ -1118,7 +1118,8 @@ class DistributedTrainer(Trainer):
         # ShardedDataset — the host arm streams out-of-core data the
         # same way the emulated arms do, with peak memory bounded by
         # the segments concurrently in flight across threads
-        shard_cache: dict[tuple[int, int], tuple[list | None, set]] = {}
+        # entry: (shards | None | BaseException, fetched, event, ready)
+        shard_cache: dict[tuple[int, int], tuple] = {}
         plan_cache: dict[int, list] = {}
         per_proc = num_workers // pc
         local_workers = (range(rank * per_proc, (rank + 1) * per_proc)
@@ -1169,18 +1170,32 @@ class DistributedTrainer(Trainer):
                     else:
                         shards, fetched, event, ready = entry
                         if ready:
-                            shard = (None if shards is None
-                                     else shards[w])
                             fetched.add(w)
                             _sweep_shard_cache()
-                            return shard
+                            if isinstance(shards, BaseException):
+                                raise RuntimeError(
+                                    f"segment (epoch {epoch}, slot "
+                                    f"{slot}) failed to build in "
+                                    "another worker") from shards
+                            return (None if shards is None
+                                    else shards[w])
                 if build:
-                    rows, load = epoch_plan(epoch)[slot]
-                    shards = (load().repartition(num_workers)
-                              if rows >= num_workers else None)
-                    with shard_lock:
-                        shard_cache[key] = (shards, set(), event, True)
-                    event.set()
+                    # Build failures must poison the entry before the
+                    # event fires: waiting workers re-raise instead of
+                    # blocking forever on an event nobody will set.
+                    shards: object = None
+                    try:
+                        rows, load = epoch_plan(epoch)[slot]
+                        shards = (load().repartition(num_workers)
+                                  if rows >= num_workers else None)
+                    except BaseException as exc:
+                        shards = exc
+                        raise
+                    finally:
+                        with shard_lock:
+                            shard_cache[key] = (shards, set(), event,
+                                                True)
+                        event.set()
                 else:
                     event.wait()
 

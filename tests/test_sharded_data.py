@@ -340,6 +340,29 @@ def test_host_arm_streams_sharded_dataset(tmp_path):
     assert len(t.history["staleness"][0]) == 4 * 4 * 3 * 4
 
 
+def test_host_arm_segment_build_failure_raises_not_hangs(tmp_path):
+    """A shard whose load raises must fail the whole job loudly: the
+    builder poisons the cache entry before firing the event, so the
+    other workers re-raise instead of waiting forever (ADVICE r3)."""
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    full = datasets.synthetic_classification(512, (6,), 4, seed=0)
+    paths = full.to_npz_shards(str(tmp_path / "p"), rows_per_shard=256)
+
+    boom = RuntimeError("etl exploded")
+
+    def bad_fn(ds):
+        raise boom
+
+    sd = ShardedDataset(paths).map(bad_fn)
+    t = DOWNPOUR(model_config("mlp", (6,), num_classes=4, hidden=(8,)),
+                 num_workers=4, communication_window=2, batch_size=8,
+                 num_epoch=1, learning_rate=0.01, seed=0,
+                 fidelity="host")
+    with pytest.raises(RuntimeError):
+        t.train(sd)
+
+
 def test_host_arm_records_skipped_runt_shard(tmp_path):
     """A runt shard that can't fill a batch per worker is recorded in
     the host arm's history too, never silently dropped."""
