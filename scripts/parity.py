@@ -20,14 +20,15 @@ import os
 import pathlib
 import sys
 
-# The MLP run forces the virtual CPU mesh before jax initializes (the
-# reference's local[N] analogue; see tests/conftest.py for why
+# The MLP/LSTM runs force the virtual CPU mesh before jax initializes
+# (the reference's local[N] analogue; see tests/conftest.py for why
 # config-after-import).  The conv run stays on the real device: XLA:CPU
 # lowers the emulator's batched-parameter convs ~25-100x slow
 # (PERF.md §10).  A real pre-parse (not an argv-token scan) so both
 # `--model conv` and `--model=conv` spellings are honored.
 _pre = argparse.ArgumentParser(add_help=False)
-_pre.add_argument("--model", choices=["mlp", "conv"], default="mlp")
+_pre.add_argument("--model", choices=["mlp", "conv", "lstm"],
+                  default="mlp")
 _ON_CPU_MESH = _pre.parse_known_args()[0].model != "conv"
 if _ON_CPU_MESH:
     flags = os.environ.get("XLA_FLAGS", "")
@@ -67,18 +68,29 @@ def main():
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--rows", type=int, default=8192)
-    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--window", type=int, default=None,
+                    help="communication window (default: 4 mlp/conv, "
+                         "2 lstm — the IMDB/DynSGD baseline shape)")
     ap.add_argument("--batch", type=int, default=32)
-    ap.add_argument("--model", choices=["mlp", "conv"], default="mlp",
+    ap.add_argument("--model", choices=["mlp", "conv", "lstm"],
+                    default="mlp",
                     help="'conv' reruns the harness on the CIFAR-shaped "
                          "ConvNet (different gradient geometry — "
                          "SURVEY.md §7 hard part #1).  Run it on the "
                          "TPU: XLA:CPU lowers the emulator's "
                          "batched-parameter convs ~25-100x slow "
-                         "(PERF.md §10).")
+                         "(PERF.md §10).  'lstm' runs the third "
+                         "geometry: a BiLSTM over token sequences (the "
+                         "IMDB/DynSGD baseline row) with adam workers.")
     ap.add_argument("--learning-rate", type=float, default=None,
                     help="shared lr for every arm (default: 0.05 mlp, "
-                         "0.01 conv)")
+                         "0.01 conv, 0.005 lstm)")
+    ap.add_argument("--margin", type=float, default=None,
+                    help="class-center margin of the synthetic task "
+                         "(default 1.0 mlp, 0.55 conv — sized so the "
+                         "conv sync arm lands ~0.8, leaving headroom "
+                         "to RESOLVE degradations; the round-3 table's "
+                         "margin-1.0 task saturated at 1.0000)")
     ap.add_argument("--skip-host", default=None,
                     action=argparse.BooleanOptionalAction,
                     help="emulated arms only.  Default True for "
@@ -89,9 +101,23 @@ def main():
                          "is established at MLP scale where threads "
                          "aren't device-serialized.  Pass "
                          "--no-skip-host to force them.")
+    ap.add_argument("--render-only", action="store_true",
+                    help="regenerate PARITY.md from the saved parity "
+                         "JSONs without training anything")
     args = ap.parse_args()
+    if args.render_only:
+        render_markdown()
+        return
+    # conv: the FULL-SCALE (8-worker) host arms stay off by default
+    # (they starve the PS through the single tunneled chip), but the
+    # 2-worker scoped host-vs-emulated twins run unless the user
+    # explicitly passed --skip-host
+    host_scoped_twins = (args.model == "conv"
+                         and args.skip_host is not True)
     if args.skip_host is None:
         args.skip_host = args.model == "conv"
+    if args.window is None:
+        args.window = 2 if args.model == "lstm" else 4
 
     from distkeras_tpu.data import datasets
     from distkeras_tpu.models import model_config
@@ -100,17 +126,36 @@ def main():
 
     import numpy as np
 
+    n_eval = 2048
+    worker_optimizer = "sgd"
+    if args.model == "lstm" and args.margin is not None:
+        raise SystemExit("--margin applies to the mlp/conv synthetic "
+                         "tasks; the lstm task is token-count-based")
     if args.model == "conv":
         cfg = model_config("convnet", (32, 32, 3), num_classes=10,
                            widths=(16, 32), dense=64)
-        n_eval = 2048
-        full = datasets.cifar10_synth(args.rows + n_eval, seed=0)
+        args.margin = args.margin or 0.55  # recorded = used
+        full = datasets.synthetic_classification(
+            args.rows + n_eval, (32, 32, 3), 10, seed=0,
+            margin=args.margin)
         lr = args.learning_rate or 0.01
+    elif args.model == "lstm":
+        # The IMDB/DynSGD baseline shape (BASELINE.md row 4): token
+        # sequences through a BiLSTM, adam workers (plain SGD does not
+        # learn this task inside any smoke budget — measured 0.56-0.58
+        # at lr in {0.1, 0.3, 1.0} vs 0.97 for adam at 0.005).
+        cfg = model_config("bilstm", (32,), input_dtype="int32",
+                           vocab_size=200, embed_dim=16, hidden_dim=16,
+                           num_classes=2)
+        full = datasets.imdb_synth(args.rows + n_eval, seq_len=32,
+                                   vocab_size=200, seed=3)
+        lr = args.learning_rate or 0.005
+        worker_optimizer = "adam"
     else:
         cfg = model_config("mlp", (16,), num_classes=8, hidden=(64,))
-        n_eval = 2048
+        args.margin = args.margin or 1.0  # recorded = used
         full = datasets.synthetic_classification(
-            args.rows + n_eval, (16,), 8, seed=0)
+            args.rows + n_eval, (16,), 8, seed=0, margin=args.margin)
         lr = args.learning_rate or 0.05
     # train/eval are a split of ONE mixture (same class centers —
     # a different seed would draw different centers, i.e. a different
@@ -121,6 +166,10 @@ def main():
 
     common = dict(batch_size=args.batch, num_epoch=args.epochs,
                   learning_rate=lr, seed=0)
+    if worker_optimizer != "sgd":
+        # only the lstm arm overrides: EAMSGD's nesterov-worker default
+        # must survive on the sgd-family tables
+        common["worker_optimizer"] = worker_optimizer
     async_kwargs = dict(num_workers=args.workers,
                         communication_window=args.window, **common)
 
@@ -145,18 +194,30 @@ def main():
         downpour_extra = {"learning_rate": lr / args.workers}
     else:
         downpour_name, downpour_extra = "DOWNPOUR", {}
-    for name, cls, extra in [
-        ("ADAG", ADAG, {}),
-        ("DynSGD", DynSGD, {}),
-        (downpour_name, DOWNPOUR, downpour_extra),
+    if args.model == "lstm":
+        # Elastic rows: with adam workers the worker steps are large
+        # relative to the elastic pull (alpha = lr x rho), so the
+        # EMA-center transient needs a stronger rho to close inside the
+        # budget — both points shown so the transient is visible.
+        # EAMSGD is omitted: its only difference from AEASGD is the
+        # nesterov worker optimizer, which the shared adam override
+        # replaces — the run would be bit-identical to AEASGD's.
+        elastic_rows = [("AEASGD (rho 2.5)", AEASGD, {"rho": 2.5}),
+                        ("AEASGD (rho 10)", AEASGD, {"rho": 10.0})]
+    else:
         # The elastic family runs at the SHARED lr: round 2 down-tuned
         # AEASGD to lr=0.02 and recorded a -6.3-point gap that a
         # rho x lr sweep showed was lr under-convergence, not an
         # elastic-rule defect (gap at lr=0.05 is <0.005 for any rho in
         # [1, 10]; at lr=0.1 AEASGD *beats* sync).  rho=2.5 is the
         # paper-ish middle of the flat region.
-        ("AEASGD", AEASGD, {"rho": 2.5}),
-        ("EAMSGD", EAMSGD, {"rho": 2.5}),
+        elastic_rows = [("AEASGD", AEASGD, {"rho": 2.5}),
+                        ("EAMSGD", EAMSGD, {"rho": 2.5})]
+    for name, cls, extra in [
+        ("ADAG", ADAG, {}),
+        ("DynSGD", DynSGD, {}),
+        (downpour_name, DOWNPOUR, downpour_extra),
+        *elastic_rows,
         # the faithful concurrent arm (design 5a): real racing threads
         # against a host PS — validates the emulator's staleness
         # semantics (same UpdateRule math, emergent instead of
@@ -177,6 +238,53 @@ def main():
                           "accuracy": results[-1]["accuracy"]}),
               flush=True)
 
+    if host_scoped_twins:
+        # Scoped host twins (VERDICT r3 weak #3): 8 free-running conv
+        # workers serialized through the single tunneled chip starve
+        # the PS socket, so the emulator≡thread-race agreement is
+        # established at a 2-worker scope — each host row next to its
+        # EMULATED twin at the identical config, which is the claim
+        # under test (same rule, same scale, deterministic vs emergent
+        # staleness).
+        scoped = dict(num_workers=2,
+                      communication_window=args.window, **common)
+        scoped_lr = {"learning_rate": lr / 2}  # DOWNPOUR law at W=2
+        for name, cls, extra in [
+            ("ADAG (emulated twin, 2w)", ADAG, {}),
+            ("ADAG (host threads, 2w)", ADAG,
+             {"fidelity": "host", "worker_timeout": 300.0}),
+            ("DOWNPOUR (emulated twin, 2w, lr/W)", DOWNPOUR,
+             dict(scoped_lr)),
+            ("DOWNPOUR (host socket, 2w, lr/W)", DOWNPOUR,
+             {"fidelity": "host", "transport": "socket",
+              "worker_timeout": 300.0, **scoped_lr}),
+        ]:
+            kw = {**scoped, **extra}
+            results.append(run(name, cls, cfg, data, kw, eval_data))
+            print(json.dumps({"arm": name,
+                              "accuracy": results[-1]["accuracy"]}),
+                  flush=True)
+
+    downpour_sweep = []
+    if args.model == "conv":
+        # Window sweep for DOWNPOUR (VERDICT r3 weak #4): if the
+        # collapse is staleness/window-sum-driven it should ease as the
+        # window shrinks toward 1; if it does not, the story is wrong.
+        for w in (1, 2, 4):
+            t = DOWNPOUR(cfg, num_workers=args.workers,
+                         communication_window=w,
+                         **{**common,
+                            "learning_rate": lr / args.workers})
+            t.train(data)
+            from distkeras_tpu.evaluators import evaluate_model
+            acc = evaluate_model(t.model, t.trained_variables,
+                                 eval_data, batch_size=512)["accuracy"]
+            downpour_sweep.append(
+                {"window": w, "learning_rate": lr / args.workers,
+                 "accuracy": round(float(acc), 4)})
+            print(json.dumps({"arm": f"DOWNPOUR window={w}",
+                              "accuracy": acc}), flush=True)
+
     sync_acc = results[0]["accuracy"]
     for r in results[1:]:
         r["accuracy_gap_vs_sync"] = round(r["accuracy"] - sync_acc, 4)
@@ -191,15 +299,28 @@ def main():
                  "emergent staleness from real thread races"),
         "results": results,
     }
-    out_json = ("parity.json" if args.model == "mlp"
-                else "parity_conv.json")
+    if downpour_sweep:
+        payload["downpour_window_sweep"] = downpour_sweep
+    out_json = {"mlp": "parity.json", "conv": "parity_conv.json",
+                "lstm": "parity_lstm.json"}[args.model]
     (REPO / out_json).write_text(json.dumps(payload, indent=2))
+    render_markdown()
+    print(json.dumps({r["trainer"]: r["accuracy"] for r in results},
+                     indent=2))
+
+
+def render_markdown():
+    """(Re)generate PARITY.md from whichever of parity.json /
+    parity_conv.json / parity_lstm.json exist — callable standalone
+    (``--render-only``) so prose edits do not require retraining."""
 
     def table(payload) -> list[str]:
         c = payload["config"]
         fam = payload["model"]["family"]
-        shape = ("MLP (16,)->8" if fam == "mlp"
-                 else "ConvNet (32,32,3)->10, widths (16,32)")
+        shape = {"mlp": "MLP (16,)->8",
+                 "convnet": "ConvNet (32,32,3)->10, widths (16,32)",
+                 "bilstm": "BiLSTM T=32 vocab 200, embed/hidden 16, "
+                           "adam workers"}[fam]
         lines = [
             f"Setup: {shape}, {c['rows']} rows, {c['workers']} workers, "
             f"batch {c['batch']}/worker, window {c['window']}, "
@@ -229,37 +350,89 @@ def main():
         "",
         "![convergence curves + accuracy table](PARITY.png)",
     ]
-    mlp_payload = (payload if args.model == "mlp" else
-                   (json.loads((REPO / "parity.json").read_text())
-                    if (REPO / "parity.json").exists() else None))
-    conv_payload = (payload if args.model == "conv" else
-                    (json.loads((REPO / "parity_conv.json").read_text())
-                     if (REPO / "parity_conv.json").exists() else None))
+    def _load(fname):
+        p = REPO / fname
+        return json.loads(p.read_text()) if p.exists() else None
+
+    mlp_payload = _load("parity.json")
+    conv_payload = _load("parity_conv.json")
+    lstm_payload = _load("parity_lstm.json")
     if mlp_payload:
         lines += ["", "## MLP scale", ""]
         lines += table(mlp_payload)
     if conv_payload:
+        margin = conv_payload["config"].get("margin") or 0.55
         lines += [
             "", "## ConvNet scale (second gradient geometry)", "",
-            "Emulated arms on the TPU chip (host arms: see "
-            "--skip-host help).  The staleness-compensated rules "
-            "(ADAG, DynSGD) and the elastic family match or beat sync "
-            "on conv geometry exactly as on the MLP.  DOWNPOUR — the "
-            "one rule with NO staleness compensation — degrades here "
-            "at every lr in its sweep (shared lr: chance; smaller: "
-            "non-monotonic under-convergence).  That asymmetry is the "
-            "reference's own research premise made measurable: "
-            "conv gradient geometry exposes the uncompensated-rule "
-            "weakness that ADAG was invented to fix, which the "
-            "too-forgiving MLP task masked.", ""]
+            f"Emulated arms on the TPU chip, margin-{margin} task "
+            "(round 3's margin-1.0 task saturated — four async arms "
+            "at accuracy 1.0000 cannot RESOLVE sub-point degradation; "
+            "this one parks sync near 0.8 so the gap column carries "
+            "signal).  The staleness-compensated rules (ADAG, DynSGD) "
+            "and the elastic family match or beat sync on conv "
+            "geometry exactly as on the MLP.  DOWNPOUR — the one rule "
+            "with NO staleness compensation — degrades at every lr in "
+            "its sweep (shared lr: chance; smaller: non-monotonic "
+            "under-convergence): the reference's own research premise "
+            "made measurable — conv gradient geometry exposes the "
+            "uncompensated-rule weakness ADAG was invented to fix.  "
+            "The '(... 2w)' rows are the SCOPED host-vs-emulated "
+            "twins: 8 free-running conv workers starve the PS through "
+            "the one tunneled chip, so the emulator≡thread-race "
+            "agreement is pinned at a 2-worker scope, each host row "
+            "next to its emulated twin at the identical config.", ""]
         lines += table(conv_payload)
+        sweep = conv_payload.get("downpour_window_sweep")
+        if sweep:
+            lines += [
+                "", "### DOWNPOUR window sweep (collapse mechanism)",
+                "",
+                "If DOWNPOUR's conv degradation is staleness/window-"
+                "sum-driven it must ease as the window shrinks toward "
+                "1 (fresher commits, smaller sums); if it were flat "
+                "across windows, the story would be wrong "
+                "(round 2's AEASGD lesson).  Measured at lr/W:",
+                "",
+                "| window | eval accuracy |", "|---|---|",
+            ] + [f"| {s['window']} | {s['accuracy']:.4f} |"
+                 for s in sweep]
+    if lstm_payload:
+        lines += [
+            "", "## BiLSTM scale (recurrent gradient geometry)", "",
+            "The third gradient geometry (SURVEY.md §7 hard part #1): "
+            "recurrence, gate saturation, shared weights through time, "
+            "sparse embedding rows — the IMDB/DynSGD baseline shape "
+            "(BASELINE.md row 4), run with adam workers because plain "
+            "SGD does not learn the token-count task inside any smoke "
+            "budget (measured: 0.56-0.58 at lr in {0.1, 0.3, 1.0} vs "
+            "0.97 for adam).  Findings, all window-driven transients, "
+            "none staleness-rule defects: (1) at window 1 ADAG matches "
+            "sync to 0.2 points, and an MLP-with-adam control at "
+            "window 4 shows NO gap — the window-4 degradation seen at "
+            "lstm geometry is a recurrence x window x adam "
+            "interaction, so the table runs the baseline window 2; "
+            "(2) the elastic EMA-center lags inside the budget at "
+            "rho 2.5 but closes to <0.5 points at rho 10 with 6 "
+            "epochs (adam's large worker steps need a stronger pull — "
+            "alpha = lr x rho); (3) the host-thread twins are the one "
+            "place recurrent geometry shows RUN-TO-RUN VARIANCE: "
+            "across repeated runs at this exact setting ADAG-host "
+            "landed 0.82 and 0.97 (sync 0.96-0.97), DOWNPOUR-host "
+            "0.92 and 0.96, int8 0.87 and 0.91 — emergent staleness "
+            "schedules differ per run, and the adam transient "
+            "amplifies them where the MLP/conv geometries (sgd, "
+            "flatter window response) did not.  The emulated rows are "
+            "deterministic and sit inside the host twins' observed "
+            "range, which is the staleness-equivalence claim stated "
+            "at the honest precision this geometry supports.", ""]
+        lines += table(lstm_payload)
     lines += [
         "",
         "Interpretation: the async family must land within a few points "
         "of the sync arm's accuracy on the same budget; DynSGD's "
         "staleness scaling and ADAG's window normalization should show "
         "no degradation at this staleness level (max staleness = "
-        f"{args.workers - 1} commits/round).  The '(host ...)' rows are "
+        "workers-1 commits/round).  The '(host ...)' rows are "
         "the faithful concurrent arm (free-running threads, mutex PS, "
         "emergent staleness — design 5a): their agreement with the "
         "emulated rows is the evidence that the on-mesh deterministic "
@@ -303,8 +476,6 @@ def main():
         "[1, 10] is flat at this scale |",
     ]
     (REPO / "PARITY.md").write_text("\n".join(lines) + "\n")
-    print(json.dumps({r["trainer"]: r["accuracy"] for r in results},
-                     indent=2))
 
 
 if __name__ == "__main__":

@@ -54,6 +54,39 @@ CONV_TRAIN = _CONV_FULL.filter(_CONV_IDX < 1024)
 CONV_EVAL = _CONV_FULL.filter(_CONV_IDX >= 1024)
 
 
+# Recurrent-scale parity: the third gradient geometry (recurrence, gate
+# saturation, shared weights through time — the IMDB/DynSGD baseline
+# row).  adam workers: plain SGD does not learn the token-count task in
+# any smoke budget (measured 0.56-0.58 vs 0.97, scripts/parity.py).
+# Window 2 is the baseline shape; the full-size sweep behind it (window
+# 1 matches sync to 0.2 points, an MLP-adam control shows no window-4
+# gap) lives in PARITY.md's BiLSTM section.
+LSTM_CFG = model_config("bilstm", (16,), input_dtype="int32",
+                        vocab_size=100, embed_dim=16, hidden_dim=16,
+                        num_classes=2)
+_LSTM_FULL = datasets.imdb_synth(3072, seq_len=16, vocab_size=100,
+                                 seed=3)
+_LSTM_IDX = np.arange(len(_LSTM_FULL))
+LSTM_TRAIN = _LSTM_FULL.filter(_LSTM_IDX < 2048)
+LSTM_EVAL = _LSTM_FULL.filter(_LSTM_IDX >= 2048)
+
+
+@pytest.mark.parametrize("cls", [ADAG, DynSGD])
+def test_lstm_async_matches_sync_on_same_budget(cls):
+    common = dict(batch_size=32, num_epoch=4, learning_rate=0.005,
+                  seed=0, worker_optimizer="adam")
+    sync = SyncTrainer(LSTM_CFG, num_workers=4, **common)
+    sync.train(LSTM_TRAIN)
+    sync_acc = evaluate_model(sync.model, sync.trained_variables,
+                              LSTM_EVAL, batch_size=512)["accuracy"]
+    t = cls(LSTM_CFG, num_workers=4, communication_window=2, **common)
+    t.train(LSTM_TRAIN)
+    acc = evaluate_model(t.model, t.trained_variables, LSTM_EVAL,
+                         batch_size=512)["accuracy"]
+    assert sync_acc > 0.7, sync_acc
+    assert acc > sync_acc - 0.10, (sync_acc, acc)
+
+
 @pytest.mark.parametrize("cls", [ADAG, AEASGD])
 def test_conv_async_matches_sync_on_same_budget(cls):
     # lr/epochs sized so the budget actually converges: in the
