@@ -67,13 +67,37 @@ class Dataset:
         string column — ready for ``LabelIndexTransformer`` /
         ``HashBucketTransformer``.  ``names`` overrides or supplies the
         column names (required when ``header=False``); plain unquoted
-        CSV/TSV only.
-        """
-        import csv as _csv
+        CSV/TSV only.  Numeric means plain decimal spellings: hex
+        (``0x1a``) and digit-underscore (``1_000``) tokens type as
+        strings on both parse paths.
 
-        with open(path, newline="") as fh:
-            reader = _csv.reader(fh, delimiter=delimiter)
-            rows = [row for row in reader if row]
+        When the native kernels are available
+        (``distkeras_tpu.native``), tokenizing and type conversion run
+        in C with the GIL released — both faster and overlappable by
+        the out-of-core segment-prefetch thread; the Python path below
+        is the semantic reference and the fallback.
+        """
+        from distkeras_tpu import native as _native
+
+        with open(path, "rb") as fh:
+            raw = fh.read()
+
+        if (_native.available() and len(delimiter) == 1
+                and delimiter.isascii() and b'"' not in raw):
+            # a quote character anywhere sends the whole file down the
+            # csv.reader lane: the C tokenizer is plain-split and would
+            # otherwise silently disagree on quoted fields
+            ds = cls._from_csv_native(raw, path, delimiter, header,
+                                      names)
+            if ds is not None:
+                return ds
+
+        import csv as _csv
+        import io as _io
+
+        reader = _csv.reader(_io.StringIO(raw.decode(), newline=""),
+                             delimiter=delimiter)
+        rows = [row for row in reader if row]
         if not rows:
             raise ValueError(f"{path}: empty file")
         if header:
@@ -96,21 +120,74 @@ class Dataset:
                 f"{path}: duplicate column name(s) {sorted(dupes)}")
 
         def typed(values: list[str]) -> np.ndarray:
-            try:
-                return np.asarray([int(v) for v in values],
-                                  dtype=np.int64)
-            except (ValueError, OverflowError):
-                # OverflowError: ids past int64 fall through to the
-                # float/string paths instead of crashing
-                pass
-            try:
-                return np.asarray([float(v) for v in values],
-                                  dtype=np.float32)
-            except ValueError:
-                return np.asarray(values)
+            # underscore/hex spellings type as strings (int("1_0")
+            # would accept them; the native lane cannot — both lanes
+            # are strict so they agree)
+            plain = not any("_" in v or "x" in v or "X" in v
+                            for v in values)
+            if plain:
+                try:
+                    return np.asarray([int(v) for v in values],
+                                      dtype=np.int64)
+                except (ValueError, OverflowError):
+                    # OverflowError: ids past int64 fall through to the
+                    # float/string paths instead of crashing
+                    pass
+                try:
+                    return np.asarray([float(v) for v in values],
+                                      dtype=np.float32)
+                except ValueError:
+                    pass
+            return np.asarray(values)
 
         return cls({name: typed([r[c] for r in rows])
                     for c, name in enumerate(names)})
+
+    @classmethod
+    def _from_csv_native(cls, raw: bytes, path, delimiter: str,
+                         header: bool, names):
+        """C parse lane (see ``native.parse_csv``); returns ``None`` to
+        fall back to the csv.reader lane when the buffer needs it
+        (undecodable header bytes; the caller already routes quoted
+        files away)."""
+        from distkeras_tpu import native as _native
+
+        # header = first non-blank line, parsed in Python (names need
+        # decoding anyway); data region starts after it
+        skip = 0
+        if header:
+            while skip < len(raw):
+                eol = raw.find(b"\n", skip)
+                if eol < 0:
+                    eol = len(raw)
+                line = raw[skip:eol].rstrip(b"\r")
+                if line:
+                    try:
+                        file_names = line.decode().split(delimiter)
+                    except UnicodeDecodeError:
+                        return None  # csv.reader lane handles encoding
+                    skip = eol + 1
+                    break
+                skip = eol + 1
+            else:
+                raise ValueError(f"{path}: empty file")
+            names = (list(names) if names is not None
+                     else [n for n in file_names])
+        elif names is None:
+            raise ValueError("header=False needs explicit names=")
+        else:
+            names = list(names)
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"{path}: duplicate column name(s) {sorted(dupes)}")
+        try:
+            cols = _native.parse_csv(raw, skip, delimiter, names)
+        except ValueError as e:
+            if "fields" in str(e):
+                raise ValueError(f"{path}: {e}") from None
+            raise ValueError(f"{path}: no data rows") from None
+        return cls(cols)
 
     @classmethod
     def from_npz(cls, path) -> "Dataset":

@@ -86,6 +86,20 @@ def _load() -> ctypes.CDLL | None:
         lib.dense_scatter.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+        lib.csv_index.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.csv_index.restype = ctypes.c_int64
+        lib.csv_parse_numeric.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p]
+        lib.csv_parse_numeric.restype = ctypes.c_int64
+        lib.csv_fill_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -139,6 +153,56 @@ def affine_scale(col: np.ndarray, scale: np.ndarray,
     out = np.empty_like(col)
     lib.affine_scale(_ptr(col), rows, cols, _ptr(scale), _ptr(shift),
                      _ptr(out))
+    return out
+
+
+def parse_csv(data: bytes, skip: int, delimiter: str,
+              names: list[str]) -> dict[str, np.ndarray]:
+    """Tokenize + type a delimited text buffer (GIL released inside the
+    C calls).  ``data[skip:]`` holds the data rows; each column comes
+    back int64 / float32 / unicode exactly like ``Dataset.from_csv``'s
+    Python path.  Raises ``ValueError`` on ragged rows."""
+    lib = _load()
+    assert lib is not None, "check available() first"
+    cols = len(names)
+    buf = np.frombuffer(data, dtype=np.uint8)  # zero-copy view
+    max_rows = max(data.count(b"\n", skip) + 1, 1)
+    off = np.empty(max_rows * cols, dtype=np.int64)
+    lens = np.empty(max_rows * cols, dtype=np.int32)
+    rows = lib.csv_index(_ptr(buf), len(data), skip,
+                         delimiter.encode(), cols, _ptr(off),
+                         _ptr(lens))
+    if rows < 0:
+        raise ValueError(
+            f"row at data line {-rows} does not have {cols} fields")
+    if rows == 0:
+        raise ValueError("no data rows")
+    out: dict[str, np.ndarray] = {}
+    iout = np.empty(rows, dtype=np.int64)
+    fout = np.empty(rows, dtype=np.float64)
+    for c, name in enumerate(names):
+        verdict = lib.csv_parse_numeric(_ptr(buf), _ptr(off),
+                                        _ptr(lens), rows, cols, c,
+                                        _ptr(iout), _ptr(fout))
+        if verdict == 0:
+            out[name] = iout.copy()
+        elif verdict == 1:
+            out[name] = fout.astype(np.float32)
+        else:
+            width = max(
+                int(lens[:rows * cols].reshape(rows, cols)[:, c].max()),
+                1)
+            raw = np.empty(rows, dtype=f"S{width}")
+            lib.csv_fill_bytes(_ptr(buf), _ptr(off), _ptr(lens),
+                               rows, cols, c, width,
+                               _ptr(raw.view(np.uint8)))
+            try:
+                out[name] = raw.astype(f"U{width}")
+            except UnicodeDecodeError:
+                # non-ASCII bytes: decode per cell (rare; numpy's
+                # bytes->str cast is ASCII-only)
+                out[name] = np.asarray(
+                    [v.decode() for v in raw.tolist()])
     return out
 
 

@@ -25,7 +25,10 @@ SURVEY.md §5 "honest observability").
 
 from __future__ import annotations
 
+import os
 import pathlib
+import queue
+import threading
 import time
 from typing import Any, ClassVar, Mapping, Sequence
 
@@ -80,21 +83,140 @@ def _stack_batches(shard: Dataset, batch_size: int,
     return out
 
 
-def _epoch_segments(dataset, seed: int):
+def _prefetch_depth() -> int:
+    """Segments to load ahead of the consumer (0 disables).  Env-gated
+    so the IO/compute-overlap A/B (PERF.md) and the bit-identity test
+    can toggle it; prefetch never changes results, only timing."""
+    return int(os.environ.get("DKT_SEGMENT_PREFETCH", "1"))
+
+
+def _prefetch_iter(it, depth: int | None = None):
+    """Iterate ``it`` on a daemon thread, keeping up to ``depth`` items
+    built ahead of the consumer — overlaps segment IO (read / parse /
+    shuffle) with the compute consuming the previous segment.  Order-
+    preserving; iterator exceptions re-raise at the consumer's ``next``.
+    """
+    if depth is None:
+        depth = _prefetch_depth()
+    if depth <= 0:
+        yield from it
+        return
+    done = object()
+    q: queue.Queue = queue.Queue()
+    # build tickets: the feeder may hold depth items beyond the one the
+    # consumer is processing; released as the consumer moves on
+    slots = threading.Semaphore(depth + 1)
+    # set when the consumer abandons the generator mid-epoch (train
+    # error, KeyboardInterrupt): the feeder must exit rather than block
+    # in slots.acquire() forever pinning loaded segments
+    cancelled = threading.Event()
+
+    def feed():
+        try:
+            while True:
+                slots.acquire()
+                if cancelled.is_set():
+                    return
+                try:
+                    item = next(it)
+                except StopIteration:
+                    q.put(done)
+                    return
+                q.put((item,))
+        except BaseException as exc:  # surfaced on the consumer side
+            q.put(exc)
+            q.put(done)
+
+    threading.Thread(target=feed, daemon=True,
+                     name="dkt-segment-prefetch").start()
+    try:
+        while True:
+            got = q.get()
+            if got is done:
+                return
+            if isinstance(got, BaseException):
+                raise got
+            yield got[0]
+            slots.release()
+    finally:
+        cancelled.set()
+        slots.release()  # wake a feeder blocked on the ticket
+
+
+def _epoch_segments(dataset, seed: int, stall: list | None = None):
     """One epoch as in-memory ``Dataset`` segments.
 
     In-memory datasets yield exactly one segment — the whole set,
     shuffled — so existing behavior is bit-identical.  A
     ``ShardedDataset`` (``data/sharded.py``) streams its shard files in
     seed-permuted order with rows shuffled per shard, so host peak
-    memory is one shard, not the dataset (the out-of-core path; Spark's
-    partition streaming was the reference's equivalent, SURVEY.md §1
-    L0)."""
+    memory is one segment being trained plus the prefetched next
+    (Spark's partition streaming was the reference's equivalent,
+    SURVEY.md §1 L0).
+
+    ``stall`` (a one-element list) accumulates the seconds the CONSUMER
+    spent blocked waiting for segments — the IO stall the prefetch
+    thread exists to hide.  Unlike epoch wall-time it is exact, not
+    noise-bound: with prefetch off it converges to the full load cost,
+    with prefetch on to whatever the overlap could not hide."""
     from distkeras_tpu.data.sharded import ShardedDataset
 
     if isinstance(dataset, ShardedDataset):
-        return dataset.epoch_segments(seed)
-    return iter([dataset.shuffle(seed=seed)])
+        it = _prefetch_iter(dataset.epoch_segments(seed))
+    else:
+        it = iter([dataset.shuffle(seed=seed)])
+    if stall is None:
+        return it
+
+    def timed():
+        while True:
+            t0 = time.monotonic()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            stall[0] += time.monotonic() - t0
+            yield item
+    return timed()
+
+
+class _SegmentPrefetch:
+    """One-deep background segment load for plan-driven loops (the
+    emulated-PS arm, which must decide skips from metadata *before*
+    touching the file).  ``queue(key, load)`` starts ``load()`` on a
+    daemon thread; ``get(key, load)`` joins and returns it — or falls
+    back to a synchronous ``load()`` on a key mismatch, so a wrong
+    lookahead prediction costs only the overlap, never correctness.
+    Load errors re-raise in ``get`` on the consumer thread."""
+
+    def __init__(self):
+        self._key = None
+        self._thread: threading.Thread | None = None
+        self._box: dict | None = None
+
+    def queue(self, key, load):
+        box: dict = {}
+
+        def run():
+            try:
+                box["value"] = load()
+            except BaseException as exc:
+                box["error"] = exc
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="dkt-segment-prefetch")
+        t.start()
+        self._key, self._thread, self._box = key, t, box
+
+    def get(self, key, load):
+        if self._thread is not None and self._key == key:
+            self._thread.join()
+            box = self._box
+            self._key = self._thread = self._box = None
+            if "error" in box:
+                raise box["error"]
+            return box["value"]
+        return load()
 
 
 def _epoch_segment_loaders(dataset, seed: int):
@@ -126,6 +248,15 @@ class Trainer:
         ``train()`` in a ``jax.profiler`` trace written there (view
         with TensorBoard / xprof)."""
         self.spec = _resolve_spec(model)
+        if len(self.spec.kwargs.get("outputs", ())) > 1:
+            # ingested multi-output keras DAGs forward fine (tuple
+            # outputs) but training needs per-output losses, which no
+            # trainer consumes — fail here, not deep inside a jit trace
+            raise NotImplementedError(
+                "multi-output keras models cannot be trained "
+                "(per-output losses are not supported); export a "
+                "single-output submodel per head, or rebuild natively "
+                "with one loss head")
         self.model = self.spec.build()
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -276,7 +407,9 @@ class SingleTrainer(Trainer):
 
         for epoch in range(start_epoch, self.num_epoch):
             losses = []
-            for segment in _epoch_segments(dataset, self.seed + epoch):
+            stall = [0.0]
+            for segment in _epoch_segments(dataset, self.seed + epoch,
+                                           stall):
                 stacked = _stack_batches(segment, self.batch_size,
                                          self._columns())
                 if stacked is None:
@@ -293,7 +426,8 @@ class SingleTrainer(Trainer):
             if not losses:
                 raise ValueError("dataset smaller than one batch")
             epoch_loss = float(np.concatenate(losses).mean())
-            self._record(epoch_loss=epoch_loss)
+            self._record(epoch_loss=epoch_loss,
+                         segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
@@ -433,7 +567,9 @@ class SyncTrainer(Trainer):
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
             pending = []
-            for segment in _epoch_segments(dataset, self.seed + epoch):
+            stall = [0.0]
+            for segment in _epoch_segments(dataset, self.seed + epoch,
+                                           stall):
                 stacked = _stack_batches(segment, global_batch,
                                          self._columns())
                 if stacked is None:
@@ -450,7 +586,9 @@ class SyncTrainer(Trainer):
                     f"dataset smaller than one global batch "
                     f"({global_batch})")
             losses = [mesh_lib.fetch(x) for x in pending]
-            self._record(epoch_loss=float(np.concatenate(losses).mean()))
+            self._record(
+                epoch_loss=float(np.concatenate(losses).mean()),
+                segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
@@ -535,7 +673,9 @@ class SyncTrainer(Trainer):
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
             pending = []
-            for segment in _epoch_segments(dataset, self.seed + epoch):
+            stall = [0.0]
+            for segment in _epoch_segments(dataset, self.seed + epoch,
+                                           stall):
                 shard = mesh_lib.process_shard(segment)
                 stacked = _stack_batches(shard, local_batch,
                                          self._columns())
@@ -563,7 +703,9 @@ class SyncTrainer(Trainer):
                     f"dataset smaller than one global batch "
                     f"({global_batch})")
             losses = [mesh_lib.fetch(x) for x in pending]
-            self._record(epoch_loss=float(np.concatenate(losses).mean()))
+            self._record(
+                epoch_loss=float(np.concatenate(losses).mean()),
+                segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
         self.trained_variables = state.variables()
@@ -885,8 +1027,28 @@ class DistributedTrainer(Trainer):
                 return ((rows // num_workers)
                         // rows_per_worker_batch) // window
 
-            for seg_rows, load_segment in _epoch_segment_loaders(
-                    dataset, self.seed + 17 * epoch):
+            plan = list(_epoch_segment_loaders(
+                dataset, self.seed + 17 * epoch))
+            prefetch = _SegmentPrefetch()
+            seg_stall = 0.0
+
+            def next_loadable(j: int, rb: int) -> int | None:
+                # metadata-only replay of this loop's own skip rules,
+                # to find which segment after j will actually load —
+                # a wrong answer only costs the overlap (get() falls
+                # back to a synchronous load on key mismatch)
+                rb += predicted_rounds(plan[j][0])
+                for k in range(j + 1, len(plan)):
+                    hint = predicted_rounds(plan[k][0])
+                    if rb + hint <= first_round and hint > 0:
+                        rb += hint
+                        continue
+                    if plan[k][0] < num_workers:
+                        continue
+                    return k
+                return None
+
+            for seg_j, (seg_rows, load_segment) in enumerate(plan):
                 sr_hint = predicted_rounds(seg_rows)
                 if round_base + sr_hint <= first_round and sr_hint > 0:
                     # resume fast-path: every round of this segment
@@ -906,7 +1068,13 @@ class DistributedTrainer(Trainer):
                     if record_this_segment:
                         self._record(skipped_segment_rows=seg_rows)
                     continue
-                segment = load_segment()
+                t_get = time.monotonic()
+                segment = prefetch.get(seg_j, load_segment)
+                seg_stall += time.monotonic() - t_get
+                if _prefetch_depth() > 0:
+                    nxt = next_loadable(seg_j, round_base)
+                    if nxt is not None:
+                        prefetch.queue(nxt, plan[nxt][1])
                 shards = segment.repartition(num_workers)
                 # Multi-host: stack only this process's workers' shards
                 # (segment order is seed-deterministic, so every process
@@ -985,7 +1153,8 @@ class DistributedTrainer(Trainer):
                     f"communication window ({window}) in any segment")
             if pending is not None:
                 drain(pending)
-            self._record(epoch_loss=float(np.mean(epoch_losses)))
+            self._record(epoch_loss=float(np.mean(epoch_losses)),
+                         segment_stall_s=round(seg_stall, 4))
             if getattr(self, "_eval_dataset", None) is not None:
                 self._eval_epoch({
                     "params": ps_state.center,
@@ -1014,8 +1183,6 @@ class DistributedTrainer(Trainer):
         arm.  The PS address travels by collective broadcast; the final
         center, staleness log, and epoch telemetry are broadcast/
         reduced so every process returns identical results."""
-        import threading
-
         from distkeras_tpu.parallel.compression import (raw_nbytes,
                                                         resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
@@ -1151,13 +1318,50 @@ class DistributedTrainer(Trainer):
                         dataset, self.seed + 17 * epoch))
                 return plan_cache[epoch]
 
+        def build_segment(key: tuple[int, int],
+                          event: threading.Event):
+            """Load/shuffle/repartition segment ``key`` and publish it.
+            Build failures poison the entry before the event fires:
+            waiting workers re-raise instead of blocking forever on an
+            event nobody will set."""
+            epoch, slot = key
+            shards: object = None
+            try:
+                rows, load = epoch_plan(epoch)[slot]
+                shards = (load().repartition(num_workers)
+                          if rows >= num_workers else None)
+            except BaseException as exc:
+                shards = exc
+                raise
+            finally:
+                with shard_lock:
+                    shard_cache[key] = (shards, set(), event, True)
+                event.set()
+
+        def prefetch_segment(epoch: int, slot: int):
+            """Background one-ahead build: claim the entry if nobody
+            has, then build it through the same publish/poison path a
+            requesting worker would use."""
+            key = (epoch, slot)
+            with shard_lock:
+                if key in shard_cache:
+                    return
+                event = threading.Event()
+                shard_cache[key] = (None, set(), event, False)
+            try:
+                build_segment(key, event)
+            except BaseException:
+                pass  # poisoned entry re-raises in every requester
+
         def segment_shard(epoch: int, slot: int, w: int):
             """Worker ``w``'s slice of segment ``slot``; None when the
             segment cannot give every worker a row.  The segment is
             built (loaded / shuffled / repartitioned) OUTSIDE the lock
             by the first requester — other workers wait on its event,
             and requesters of cached or different segments never block
-            behind the IO."""
+            behind the IO.  A successful build kicks a one-ahead
+            background build of the next slot so segment IO overlaps
+            the epoch's compute."""
             key = (epoch, slot)
             while True:
                 build = False
@@ -1180,22 +1384,14 @@ class DistributedTrainer(Trainer):
                             return (None if shards is None
                                     else shards[w])
                 if build:
-                    # Build failures must poison the entry before the
-                    # event fires: waiting workers re-raise instead of
-                    # blocking forever on an event nobody will set.
-                    shards: object = None
-                    try:
-                        rows, load = epoch_plan(epoch)[slot]
-                        shards = (load().repartition(num_workers)
-                                  if rows >= num_workers else None)
-                    except BaseException as exc:
-                        shards = exc
-                        raise
-                    finally:
-                        with shard_lock:
-                            shard_cache[key] = (shards, set(), event,
-                                                True)
-                        event.set()
+                    build_segment(key, event)
+                    nxt = slot + 1
+                    if (_prefetch_depth() > 0
+                            and nxt < len(epoch_plan(epoch))):
+                        threading.Thread(
+                            target=prefetch_segment, args=(epoch, nxt),
+                            daemon=True,
+                            name="dkt-segment-prefetch").start()
                 else:
                     event.wait()
 
