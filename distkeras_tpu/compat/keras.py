@@ -16,19 +16,25 @@ weights are plain arrays.  When Keras *is* installed, ``from_keras``
 takes a live model.
 
 Supported layers: InputLayer, Dense, Activation, Dropout, Flatten,
-Conv2D, MaxPooling2D, AveragePooling2D, GlobalAveragePooling2D,
-Embedding, BatchNormalization, LSTM, Bidirectional(LSTM) — the
-reference's IMDB workflow shape — plus the merge layers (Add /
-Subtract / Multiply / Average / Maximum / Concatenate) for functional
-DAGs.  Anything else raises with the layer name so the gap is
-visible, not silent.
+Conv1D, Conv2D, SeparableConv2D, MaxPooling2D, AveragePooling2D,
+GlobalAveragePooling2D, Embedding, BatchNormalization, LSTM, GRU
+(``reset_after=True``, the keras >= 2.3 default), SimpleRNN,
+Bidirectional(LSTM|GRU) — the reference's IMDB workflow shape — plus
+the merge layers (Add / Subtract / Multiply / Average / Maximum /
+Concatenate) for functional DAGs.  Anything else raises with the
+layer name so the gap is visible, not silent.
 
 Model topologies: ``Sequential``; functional ``Model(inputs,
 outputs)`` graphs — linear chains lower to the ``keras_sequential``
-family, true DAGs (branches + merges) to ``keras_graph``; multi-input
-models whose inputs are all rank-1 ingest as ONE concatenated features
-array with per-input column slices (the reference-era Wide&Deep
-shape).  Multi-output models and shared (twice-called) layers raise.
+family, true DAGs (branches + merges) to ``keras_graph``; SHARED
+layers (called more than once) lower to one flax module applied at
+every call node — one parameter set, keras's own sharing semantics;
+multi-OUTPUT models forward as a tuple in ``output_layers`` order
+(trainers reject them loudly — per-output losses are not supported);
+multi-input models whose inputs are all rank-1 ingest as ONE
+concatenated features array with per-input column slices (the
+reference-era Wide&Deep shape).  Higher-rank multi-input still
+raises.
 """
 
 from __future__ import annotations
@@ -118,6 +124,40 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
                 "padding": str(cfg.get("padding", "valid")).upper(),
                 "use_bias": bool(cfg.get("use_bias", True)),
                 "activation": cfg.get("activation", "linear")}
+    if class_name == "Conv1D":
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise NotImplementedError(
+                "only channels_last Conv1D is supported")
+        def one(v):
+            return int(v[0]) if isinstance(v, (list, tuple)) else int(v)
+        if one(cfg.get("dilation_rate", 1)) != 1:
+            raise NotImplementedError("dilated Conv1D is not supported")
+        if int(cfg.get("groups", 1)) != 1:
+            raise NotImplementedError("grouped Conv1D is not supported")
+        padding = str(cfg.get("padding", "valid")).upper()
+        if padding == "CAUSAL":
+            raise NotImplementedError(
+                "Conv1D(padding='causal') is not supported")
+        return {"kind": "conv1d", "filters": int(cfg["filters"]),
+                "kernel_size": one(cfg["kernel_size"]),
+                "strides": one(cfg.get("strides", 1)),
+                "padding": padding,
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
+    if class_name == "SeparableConv2D":
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise NotImplementedError(
+                "only channels_last SeparableConv2D is supported")
+        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+            raise NotImplementedError(
+                "dilated SeparableConv2D is not supported")
+        return {"kind": "sepconv2d", "filters": int(cfg["filters"]),
+                "kernel_size": list(_pair(cfg["kernel_size"])),
+                "strides": list(_pair(cfg.get("strides", 1))),
+                "padding": str(cfg.get("padding", "valid")).upper(),
+                "depth_multiplier": int(cfg.get("depth_multiplier", 1)),
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
     if class_name in ("MaxPooling2D", "AveragePooling2D"):
         pool = _pair(cfg.get("pool_size", 2))
         return {"kind": "pool",
@@ -149,18 +189,26 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
         return norm
     if class_name == "LSTM":
         return _normalize_lstm(cfg, kind="lstm")
+    if class_name == "GRU":
+        return _normalize_gru(cfg, kind="gru")
+    if class_name == "SimpleRNN":
+        return _normalize_simple_rnn(cfg)
     if class_name == "Bidirectional":
         inner = cfg.get("layer", {})
-        if inner.get("class_name") != "LSTM":
+        inner_cls = inner.get("class_name")
+        if inner_cls not in ("LSTM", "GRU"):
             raise NotImplementedError(
-                f"Bidirectional({inner.get('class_name')!r}) is not "
-                f"supported; only Bidirectional(LSTM)")
+                f"Bidirectional({inner_cls!r}) is not supported; only "
+                f"Bidirectional(LSTM) and Bidirectional(GRU)")
         if cfg.get("merge_mode", "concat") != "concat":
             raise NotImplementedError(
                 f"Bidirectional merge_mode="
                 f"{cfg.get('merge_mode')!r} is not supported; only "
                 f"'concat'")
-        return _normalize_lstm(inner.get("config", {}), kind="bilstm")
+        if inner_cls == "LSTM":
+            return _normalize_lstm(inner.get("config", {}),
+                                   kind="bilstm")
+        return _normalize_gru(inner.get("config", {}), kind="bigru")
     if class_name == "BatchNormalization":
         if not (cfg.get("center", True) and cfg.get("scale", True)):
             raise NotImplementedError(
@@ -211,6 +259,56 @@ def _normalize_lstm(cfg: Mapping[str, Any], kind: str) -> dict:
                                              False))}
 
 
+def _rnn_common_checks(cfg: Mapping[str, Any], what: str):
+    if not cfg.get("use_bias", True):
+        raise NotImplementedError(
+            f"{what}(use_bias=False) not supported")
+    if cfg.get("go_backwards"):
+        raise NotImplementedError(
+            f"{what}(go_backwards=True) not supported "
+            f"(use Bidirectional)")
+    if cfg.get("dropout") or cfg.get("recurrent_dropout"):
+        raise NotImplementedError(
+            f"{what} dropout/recurrent_dropout are not supported — "
+            f"silently dropping them would change training behavior")
+    if cfg.get("stateful"):
+        raise NotImplementedError(f"stateful {what} is not supported")
+
+
+def _normalize_gru(cfg: Mapping[str, Any], kind: str) -> dict:
+    """GRU maps onto ``flax.linen.GRUCell`` exactly when keras runs its
+    modern form: ``reset_after=True`` (the keras >= 2.3 default) applies
+    the reset gate to the *transformed* hidden state, which is flax's
+    ``r * (W_hn h + b_hn)``; the legacy ``reset_after=False`` resets the
+    raw ``h`` before the matmul — a different equation, rejected."""
+    if cfg.get("activation", "tanh") != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise NotImplementedError(
+            f"GRU with activation={cfg.get('activation')!r} / "
+            f"recurrent_activation="
+            f"{cfg.get('recurrent_activation')!r} is not supported; "
+            f"only tanh/sigmoid")
+    if not cfg.get("reset_after", True):
+        raise NotImplementedError(
+            "GRU(reset_after=False) (the pre-keras-2.3 form) applies "
+            "the reset gate before the recurrent matmul, which flax's "
+            "GRUCell cannot express; re-export with reset_after=True")
+    _rnn_common_checks(cfg, "GRU")
+    return {"kind": kind, "units": int(cfg["units"]),
+            "return_sequences": bool(cfg.get("return_sequences",
+                                             False))}
+
+
+def _normalize_simple_rnn(cfg: Mapping[str, Any]) -> dict:
+    activation = cfg.get("activation", "tanh")
+    _activation(activation)  # raises on unsupported names
+    _rnn_common_checks(cfg, "SimpleRNN")
+    return {"kind": "simple_rnn", "units": int(cfg["units"]),
+            "activation": activation,
+            "return_sequences": bool(cfg.get("return_sequences",
+                                             False))}
+
+
 def _infer_input_shape(arch: Mapping[str, Any]) -> tuple[int, ...] | None:
     """Per-sample input shape from the first layer's
     ``batch_shape`` (keras 3) / ``batch_input_shape`` (keras 1/2),
@@ -228,20 +326,31 @@ def _infer_input_shape(arch: Mapping[str, Any]) -> tuple[int, ...] | None:
     return None
 
 
-def _inbound_names(node) -> list[str]:
-    """Predecessor layer names from one inbound-node entry.
+def _inbound_refs(node) -> list[tuple[str, int]]:
+    """Predecessor ``(layer name, producing call index)`` pairs from
+    one inbound-node entry.  The call index is what distinguishes the
+    outputs of a SHARED layer (called more than once).
 
     Keras 2 era (the reference's format): a list of
     ``[name, node_index, tensor_index, kwargs]`` quads.  Keras 3: a
     dict whose args/kwargs embed ``__keras_tensor__`` objects carrying
-    ``keras_history = [name, node, tensor]``."""
-    names: list[str] = []
+    ``keras_history = [name, node_index, tensor_index]``."""
+    refs: list[tuple[str, int]] = []
+
+    def add(name, node_index, tensor_index):
+        if int(tensor_index) != 0:
+            raise NotImplementedError(
+                f"layer {name!r} produces multiple output tensors "
+                f"(tensor_index {tensor_index}); multi-output LAYERS "
+                f"are not supported (multi-output MODELS are)")
+        refs.append((name, int(node_index)))
+
     if isinstance(node, Mapping):
         def walk(obj):
             if isinstance(obj, Mapping):
                 if obj.get("class_name") == "__keras_tensor__":
-                    names.append(
-                        obj.get("config", {})["keras_history"][0])
+                    hist = obj.get("config", {})["keras_history"]
+                    add(hist[0], hist[1], hist[2])
                 else:
                     for v in obj.values():
                         walk(v)
@@ -252,65 +361,65 @@ def _inbound_names(node) -> list[str]:
         walk(node.get("kwargs", {}))
     else:
         for item in node:
-            names.append(item[0])
-    return names
+            add(item[0], item[1], item[2])
+    return refs
 
 
-def _ref_names(refs) -> list[str]:
-    """Layer names out of ``input_layers``/``output_layers``: either
-    one ``[name, 0, 0]`` ref (keras 3 single), a list of such refs
-    (multi / keras 2), or a bare name list."""
+def _ref_pairs(refs) -> list[tuple[str, int]]:
+    """``(name, call index)`` pairs out of ``input_layers`` /
+    ``output_layers``: either one ``[name, node, tensor]`` ref (keras 3
+    single), a list of such refs (multi / keras 2), or a bare name
+    list."""
     if not refs:
         return []
     if isinstance(refs[0], str):
         # ["name", 0, 0] (single ref) vs ["a", "b"] (keras-3 multi)
-        if len(refs) == 3 and refs[1:] == [0, 0]:
-            return [refs[0]]
-        return [r for r in refs if isinstance(r, str)]
-    return [r[0] for r in refs]
+        if len(refs) == 3 and not isinstance(refs[1], str) \
+                and not isinstance(refs[2], str):
+            return [(refs[0], int(refs[1]))]
+        return [(r, 0) for r in refs if isinstance(r, str)]
+    return [(r[0], int(r[1])) for r in refs]
 
 
 def _parse_functional(arch: Mapping[str, Any]) -> dict:
     """Functional ``Model(inputs, outputs)`` graphs → a JSON-able graph
-    spec (round-2 ingested linear chains only; the topology walker now
-    covers true DAGs — branches and merge layers).
+    spec.
 
-    Supported: single-output DAGs built from the normalized layer set
-    plus the merge layers (Add/Subtract/Multiply/Average/Maximum/
-    Concatenate).  Multi-INPUT models ingest when every input is
-    rank-1 ``[None, d]``: the inputs concatenate (in ``input_layers``
-    order) into one features array and each Input node slices its
-    columns back out — the reference-era Wide&Deep shape.  Still
-    rejected, loudly: shared layers (called more than once),
-    multi-output models, and multi-input models with higher-rank
-    inputs."""
+    Supported: DAGs built from the normalized layer set plus the merge
+    layers (Add/Subtract/Multiply/Average/Maximum/Concatenate);
+    SHARED layers (called more than once — one graph node per call,
+    all calls applying one parameter set); MULTI-OUTPUT models (the
+    forward returns a tuple in ``output_layers`` order — trainers that
+    need a single loss head reject them loudly).  Multi-INPUT models
+    ingest when every input is rank-1 ``[None, d]``: the inputs
+    concatenate (in ``input_layers`` order) into one features array
+    and each Input node slices its columns back out — the
+    reference-era Wide&Deep shape; higher-rank multi-input is still
+    rejected loudly."""
     config = arch.get("config", {})
     raw_layers = config.get("layers", [])
     if not raw_layers:
         raise ValueError("keras architecture contains no layers")
     names: list[str] = []
     by_name: dict[str, dict] = {}
-    preds: dict[str, list[str]] = {}
+    call_preds: dict[str, list[list[tuple[str, int]]]] = {}
     for entry in raw_layers:
         name = entry.get("name") or entry.get("config", {}).get("name")
         if name is None:
             raise ValueError("functional layer entry has no name")
         names.append(name)
         by_name[name] = entry
-        nodes = entry.get("inbound_nodes", [])
-        if len(nodes) > 1:
-            raise NotImplementedError(
-                f"layer {name!r} is called {len(nodes)} times (shared "
-                f"layer); weight mapping for shared layers is "
-                f"ambiguous — rebuild natively")
-        preds[name] = _inbound_names(nodes[0]) if nodes else []
+        inbound = entry.get("inbound_nodes", [])
+        # one CALL per inbound node; an InputLayer (no inbound) is one
+        # call with no predecessors
+        call_preds[name] = ([_inbound_refs(n) for n in inbound]
+                            or [[]])
 
-    out_names = _ref_names(config.get("output_layers", []))
-    if len(out_names) != 1:
-        raise NotImplementedError(
-            f"multi-output functional models are not supported "
-            f"(outputs: {out_names}); only a single output head")
-    in_names = _ref_names(config.get("input_layers", []))
+    out_refs = _ref_pairs(config.get("output_layers", []))
+    if not out_refs:
+        raise ValueError("functional model declares no output layers")
+    in_refs = _ref_pairs(config.get("input_layers", []))
+    in_names = [n for n, _ in in_refs]
     if not in_names:
         raise ValueError("functional model declares no input layers")
 
@@ -333,77 +442,125 @@ def _parse_functional(arch: Mapping[str, Any]) -> dict:
             input_slices.append([n, start, start + width])
             start += width
 
-    # Kahn topological order over the whole graph.
-    pending = {n: len(preds[n]) for n in names}
-    ready = [n for n in names if pending[n] == 0]
-    topo: list[str] = []
+    # Call-node ids in config-list order (layers with one call keep
+    # id == config position, the round-3 numbering); params are keyed
+    # by config position — the keras get_weights() order.
+    id_of_call: dict[tuple[str, int], int] = {}
+    param_of: dict[str, int] = {}
+    for i, n in enumerate(names):
+        param_of[n] = i
+        for j in range(len(call_preds[n])):
+            id_of_call[(n, j)] = len(id_of_call)
+
+    def resolve(ref: tuple[str, int], consumer: str) -> int:
+        name, j = ref
+        if (name, j) not in id_of_call:
+            raise ValueError(
+                f"layer {consumer!r} consumes call {j} of {name!r}, "
+                f"which has only "
+                f"{len(call_preds.get(name, []))} call(s)")
+        return id_of_call[(name, j)]
+
+    preds_by_id: dict[int, list[int]] = {}
+    for n in names:
+        for j, refs in enumerate(call_preds[n]):
+            preds_by_id[id_of_call[(n, j)]] = [
+                resolve(r, n) for r in refs]
+
+    # Kahn topological order over call nodes.
+    total = len(id_of_call)
+    pending = {i: len(preds_by_id[i]) for i in range(total)}
+    ready = [i for i in range(total) if pending[i] == 0]
+    topo: list[int] = []
+    succs: dict[int, list[int]] = {i: [] for i in range(total)}
+    for i, ps in preds_by_id.items():
+        for p in ps:
+            succs[p].append(i)
     while ready:
         cur = ready.pop(0)
         topo.append(cur)
-        for m in names:
-            if cur in preds[m]:
-                pending[m] -= preds[m].count(cur)
-                if pending[m] == 0:
-                    ready.append(m)
-    if len(topo) != len(names):
+        for m in succs[cur]:
+            pending[m] -= 1
+            if pending[m] == 0:
+                ready.append(m)
+    if len(topo) != total:
         raise ValueError(
-            f"functional graph is cyclic or disconnected at "
-            f"{sorted(set(names) - set(topo))}")
+            "functional graph is cyclic or disconnected at call "
+            f"nodes {sorted(set(range(total)) - set(topo))}")
 
-    id_of = {n: i for i, n in enumerate(names)}  # config-list position
     nodes = []
     for n in names:
         entry = by_name[n]
-        if entry["class_name"] == "InputLayer" or n in in_names:
-            node = {"kind": "input"}
-        else:
-            node = _normalize_layer(entry["class_name"],
-                                    entry.get("config", {}))
-            if node is None:  # InputLayer is routed above; cannot occur
-                raise AssertionError(entry["class_name"])
-            p = preds[n]
-            if node["kind"].startswith("merge_"):
-                if len(p) < 2:
+        shared = len(call_preds[n]) > 1
+        for j in range(len(call_preds[n])):
+            nid = id_of_call[(n, j)]
+            p = preds_by_id[nid]
+            if entry["class_name"] == "InputLayer" or n in in_names:
+                if shared:
                     raise ValueError(
-                        f"merge layer {n!r} has {len(p)} inputs")
-            elif len(p) != 1:
-                raise NotImplementedError(
-                    f"layer {n!r} ({entry['class_name']}) takes "
-                    f"{len(p)} input tensors; only merge layers may "
-                    f"take several")
-        node["id"] = id_of[n]
-        node["inputs"] = [id_of[q] for q in preds[n]]
-        nodes.append(node)
+                        f"input layer {n!r} has {len(call_preds[n])} "
+                        f"inbound nodes")
+                node = {"kind": "input"}
+            else:
+                node = dict(_normalize_layer(entry["class_name"],
+                                             entry.get("config", {}))
+                            or {})
+                if not node:  # InputLayer routed above; cannot occur
+                    raise AssertionError(entry["class_name"])
+                if node["kind"].startswith("merge_"):
+                    if len(p) < 2:
+                        raise ValueError(
+                            f"merge layer {n!r} has {len(p)} inputs")
+                elif len(p) != 1:
+                    raise NotImplementedError(
+                        f"layer {n!r} ({entry['class_name']}) takes "
+                        f"{len(p)} input tensors; only merge layers "
+                        f"may take several")
+            node["id"] = nid
+            node["param"] = param_of[n]
+            node["inputs"] = list(p)
+            nodes.append(node)
+    nodes.sort(key=lambda nd: nd["id"])
 
     return {
-        "nodes": nodes,                       # config-list order
-        "topo": [id_of[n] for n in topo],
-        "output": id_of[out_names[0]],
-        "input_slices": [[id_of[n], a, b] for n, a, b in input_slices],
+        "nodes": nodes,                       # call-id order
+        "topo": topo,
+        "outputs": [resolve(r, "<output_layers>") for r in out_refs],
+        "input_slices": [[id_of_call[(n, 0)], a, b]
+                         for n, a, b in input_slices],
     }
 
 
 def _graph_is_chain(graph: dict) -> list[dict] | None:
-    """A single-input, merge-free, branch-free DAG whose config-list
-    order is already executable (keras serializes layers in its own
-    topological order, which is also ``get_weights()`` order) is a
-    plain chain: return its normalized layer list so it lowers to the
-    simpler ``keras_sequential`` family; ``None`` otherwise."""
+    """A single-input, single-output, merge-free, branch-free,
+    share-free DAG whose config-list order is already executable
+    (keras serializes layers in its own topological order, which is
+    also ``get_weights()`` order) is a plain chain: return its
+    normalized layer list so it lowers to the simpler
+    ``keras_sequential`` family; ``None`` otherwise."""
     nodes = graph["nodes"]
+    if len(graph["outputs"]) != 1:
+        return None
     n_inputs = sum(1 for n in nodes if n["kind"] == "input")
     if n_inputs != 1:
         return None
+    param_calls: dict[int, int] = {}
     succ_count: dict[int, int] = {}
     for n in nodes:
         if n["kind"].startswith("merge_"):
             return None
+        pc = param_calls.get(n["param"], 0) + 1
+        param_calls[n["param"]] = pc
+        if pc > 1:
+            return None  # shared layer: needs the graph family
         for i in n["inputs"]:
             succ_count[i] = succ_count.get(i, 0) + 1
         if any(i >= n["id"] for i in n["inputs"]):
             return None  # config order not executable: graph path
     if any(c > 1 for c in succ_count.values()):
         return None
-    return [{k: v for k, v in n.items() if k not in ("id", "inputs")}
+    return [{k: v for k, v in n.items()
+             if k not in ("id", "inputs", "param")}
             for n in nodes if n["kind"] != "input"]
 
 
@@ -457,17 +614,33 @@ class KerasSequential(nn.Module):
         return x
 
 
-def _apply_layer(layer, name: str, x, dtype, train: bool):
+def _apply_layer(layer, name: str, x, dtype, train: bool,
+                 memo: dict | None = None):
     """One normalized layer's forward.  Called from inside a module's
     ``@nn.compact`` ``__call__`` — flax binds the submodules created
     here to the calling module, so ``KerasSequential`` and
     ``KerasGraph`` share one per-kind implementation (and one
-    weight-mapping convention)."""
+    weight-mapping convention).
+
+    ``memo`` (per parameter id, graph family only) caches the created
+    submodules across calls: a keras layer called at several graph
+    nodes lowers to one flax module applied several times — the flax
+    weight-sharing idiom.  Explicitly-named modules MUST go through it
+    (flax rejects a second same-name creation)."""
     kind = layer["kind"]
+
+    def get(key: str, ctor):
+        if memo is None:
+            return ctor()
+        if key not in memo:
+            memo[key] = ctor()
+        return memo[key]
+
     if kind == "dense":
         # contracts the last axis, any rank — keras semantics
-        x = nn.Dense(layer["units"], use_bias=layer["use_bias"],
-                     dtype=dtype, name=name)(x)
+        x = get("m", lambda: nn.Dense(
+            layer["units"], use_bias=layer["use_bias"],
+            dtype=dtype, name=name))(x)
         return _activation(layer["activation"])(x)
     if kind == "activation":
         return _activation(layer["activation"])(x)
@@ -475,12 +648,28 @@ def _apply_layer(layer, name: str, x, dtype, train: bool):
         return nn.Dropout(layer["rate"], deterministic=not train)(x)
     if kind == "flatten":
         return x.reshape((x.shape[0], -1))
-    if kind == "conv2d":
-        x = nn.Conv(layer["filters"], tuple(layer["kernel_size"]),
-                    strides=tuple(layer["strides"]),
-                    padding=layer["padding"],
-                    use_bias=layer["use_bias"],
-                    dtype=dtype, name=name)(x)
+    if kind in ("conv2d", "conv1d"):
+        size = (tuple(layer["kernel_size"])
+                if kind == "conv2d" else (layer["kernel_size"],))
+        strides = (tuple(layer["strides"])
+                   if kind == "conv2d" else (layer["strides"],))
+        x = get("m", lambda: nn.Conv(
+            layer["filters"], size, strides=strides,
+            padding=layer["padding"], use_bias=layer["use_bias"],
+            dtype=dtype, name=name))(x)
+        return _activation(layer["activation"])(x)
+    if kind == "sepconv2d":
+        channels = int(x.shape[-1])
+        mult = layer["depth_multiplier"]
+        x = get("dw", lambda: nn.Conv(
+            channels * mult, tuple(layer["kernel_size"]),
+            strides=tuple(layer["strides"]),
+            padding=layer["padding"], use_bias=False,
+            feature_group_count=channels,
+            dtype=dtype, name=name + "_dw"))(x)
+        x = get("pw", lambda: nn.Conv(
+            layer["filters"], (1, 1), use_bias=layer["use_bias"],
+            dtype=dtype, name=name + "_pw"))(x)
         return _activation(layer["activation"])(x)
     if kind == "pool":
         fn = nn.max_pool if layer["op"] == "max" else nn.avg_pool
@@ -490,32 +679,46 @@ def _apply_layer(layer, name: str, x, dtype, train: bool):
     if kind == "global_avg_pool":
         return x.mean(axis=(1, 2))
     if kind == "embedding":
-        return nn.Embed(layer["input_dim"], layer["output_dim"],
-                        dtype=dtype, name=name)(x.astype(jnp.int32))
+        return get("m", lambda: nn.Embed(
+            layer["input_dim"], layer["output_dim"],
+            dtype=dtype, name=name))(x.astype(jnp.int32))
     if kind == "batchnorm":
-        return nn.BatchNorm(use_running_average=not train,
-                            epsilon=layer["epsilon"],
-                            momentum=layer["momentum"],
-                            dtype=dtype, name=name)(x)
-    if kind == "lstm":
+        return get("m", lambda: nn.BatchNorm(
+            use_running_average=not train,
+            epsilon=layer["epsilon"], momentum=layer["momentum"],
+            dtype=dtype, name=name))(x)
+    if kind in ("lstm", "gru", "simple_rnn"):
         # the RNN wrapper owns no params; naming the CELL is what pins
-        # the weight-mapping path
-        y = nn.RNN(nn.OptimizedLSTMCell(layer["units"], dtype=dtype,
-                                        name=name))(x)
+        # the weight-mapping path (and what a shared layer reuses)
+        cell = get("cell", lambda: _make_cell(kind, layer, dtype, name))
+        y = nn.RNN(cell)(x)
         return y if layer["return_sequences"] else y[:, -1]
-    if kind == "bilstm":
-        # keras Bidirectional(LSTM, merge_mode='concat'): backward
-        # outputs are time-aligned (keep_order); its "last" output is
-        # the one at original index 0
-        yf = nn.RNN(nn.OptimizedLSTMCell(
-            layer["units"], dtype=dtype, name=name + "_fwd"))(x)
-        yb = nn.RNN(nn.OptimizedLSTMCell(
-            layer["units"], dtype=dtype, name=name + "_bwd"),
-            reverse=True, keep_order=True)(x)
+    if kind in ("bilstm", "bigru"):
+        # keras Bidirectional(merge_mode='concat'): backward outputs
+        # are time-aligned (keep_order); its "last" output is the one
+        # at original index 0
+        base = "lstm" if kind == "bilstm" else "gru"
+        fwd = get("fwd", lambda: _make_cell(base, layer, dtype,
+                                            name + "_fwd"))
+        bwd = get("bwd", lambda: _make_cell(base, layer, dtype,
+                                            name + "_bwd"))
+        yf = nn.RNN(fwd)(x)
+        yb = nn.RNN(bwd, reverse=True, keep_order=True)(x)
         if layer["return_sequences"]:
             return jnp.concatenate([yf, yb], axis=-1)
         return jnp.concatenate([yf[:, -1], yb[:, 0]], axis=-1)
     raise AssertionError(kind)  # unreachable: _normalize_layer gates
+
+
+def _make_cell(base: str, layer, dtype, name: str):
+    if base == "lstm":
+        return nn.OptimizedLSTMCell(layer["units"], dtype=dtype,
+                                    name=name)
+    if base == "gru":
+        return nn.GRUCell(layer["units"], dtype=dtype, name=name)
+    return nn.SimpleCell(layer["units"],
+                         activation_fn=_activation(layer["activation"]),
+                         dtype=dtype, name=name)
 
 
 def _apply_merge(kind: str, ins, layer=None):
@@ -559,17 +762,26 @@ def _apply_merge(kind: str, ins, layer=None):
 class KerasGraph(nn.Module):
     """Flax twin of an ingested keras functional DAG.
 
-    ``nodes`` is ``_parse_functional``'s node list in config-list order
-    (= the keras ``get_weights()`` order — parameterized nodes are
-    named ``layer_{id}`` by that position); ``topo`` is an executable
-    order; ``output`` the result node id.  ``input_slices`` (multi-
-    input models) map each Input node to its column slice of the single
-    concatenated features array; empty means one Input taking ``x``
-    whole."""
+    ``nodes`` is ``_parse_functional``'s call-node list (one node per
+    LAYER CALL; a shared layer contributes several nodes carrying the
+    same ``param`` id).  Parameterized nodes are named
+    ``layer_{param}`` — the layer's config-list position, which is the
+    keras ``get_weights()`` order — and calls sharing a ``param``
+    apply one flax module (one parameter set).  ``topo`` is an
+    executable order; ``outputs`` the result node ids (a 1-tuple
+    returns the bare array, longer tuples return a tuple in
+    ``output_layers`` order).  ``input_slices`` (multi-input models)
+    map each Input node to its column slice of the single concatenated
+    features array; empty means one Input taking ``x`` whole.
+
+    ``output`` (int) is the round-3 single-output spelling, still
+    honored so serialized round-3 specs and checkpoints load
+    unchanged; ``outputs`` wins when non-empty."""
 
     nodes: Sequence[Mapping[str, Any]] = ()
     topo: Sequence[int] = ()
     output: int = 0
+    outputs: Sequence[int] = ()
     input_slices: Sequence[Sequence[int]] = ()
     dtype: str = "float32"
 
@@ -581,6 +793,7 @@ class KerasGraph(nn.Module):
         slices = {int(i): (int(a), int(b))
                   for i, a, b in self.input_slices}
         outs: dict[int, Any] = {}
+        memos: dict[int, dict] = {}  # param id -> created submodules
         for nid in self.topo:
             node = by_id[int(nid)]
             kind = node["kind"]
@@ -595,9 +808,13 @@ class KerasGraph(nn.Module):
             if kind.startswith("merge_"):
                 outs[int(nid)] = _apply_merge(kind, ins, node)
             else:
+                param = int(node.get("param", node["id"]))
                 outs[int(nid)] = _apply_layer(
-                    node, f"layer_{int(node['id'])}", ins[0], dtype,
-                    train)
+                    node, f"layer_{param}", ins[0], dtype, train,
+                    memo=memos.setdefault(param, {}))
+        if self.outputs:
+            result = tuple(outs[int(o)] for o in self.outputs)
+            return result[0] if len(result) == 1 else result
         return outs[int(self.output)]
 
 
@@ -642,11 +859,15 @@ def _map_weights(layers: Sequence[Mapping[str, Any]],
 
 def _map_graph_weights(graph: dict,
                        weights: Sequence[np.ndarray]) -> dict:
-    """Weight mapping for a ``KerasGraph``: nodes consumed in
-    config-list order, which is how keras serializes its own
-    topological order (= ``get_weights()`` order)."""
+    """Weight mapping for a ``KerasGraph``: one entry per LAYER (param
+    id), in config-list order — keras lists each layer's arrays once
+    in ``get_weights()`` no matter how many times it is called, and
+    all of a shared layer's call nodes apply that single set."""
+    seen: dict[int, Mapping[str, Any]] = {}
+    for n in graph["nodes"]:
+        seen.setdefault(int(n.get("param", n["id"])), n)
     return _map_named_weights(
-        [(f"layer_{n['id']}", n) for n in graph["nodes"]], weights)
+        [(f"layer_{p}", seen[p]) for p in sorted(seen)], weights)
 
 
 def _map_named_weights(named_layers, weights) -> dict:
@@ -676,16 +897,54 @@ def _map_named_weights(named_layers, weights) -> dict:
     return variables
 
 
+def _gru_cell_params(W: np.ndarray, U: np.ndarray,
+                     b: np.ndarray) -> dict:
+    """Keras fused GRU arrays (``reset_after=True``) -> flax
+    ``GRUCell`` params.
+
+    Keras packs the three gates along the last axis in order z, r, h
+    and carries TWO bias rows (input-side and recurrent-side).  Flax's
+    input denses (``iz/ir/in``) carry a bias while ``hz/hr`` do not,
+    so the z/r recurrent biases fold into the input biases (both sit
+    inside the same sigmoid, additively); the h-gate keeps them apart
+    (``in`` takes the input bias, ``hn`` the recurrent one — keras
+    ``reset_after=True`` multiplies exactly that term by r)."""
+    u = U.shape[0]
+    if W.shape[1] != 3 * u or b.shape != (2, 3 * u):
+        raise ValueError(
+            f"GRU weight shapes do not agree (expecting the "
+            f"reset_after=True layout): kernel {W.shape}, recurrent "
+            f"{U.shape}, bias {b.shape}")
+    Wz, Wr, Wh = (W[:, j * u:(j + 1) * u] for j in range(3))
+    Uz, Ur, Uh = (U[:, j * u:(j + 1) * u] for j in range(3))
+    biz, bir, bih = (b[0, j * u:(j + 1) * u] for j in range(3))
+    bhz, bhr, bhh = (b[1, j * u:(j + 1) * u] for j in range(3))
+    return {"iz": {"kernel": Wz, "bias": biz + bhz},
+            "ir": {"kernel": Wr, "bias": bir + bhr},
+            "in": {"kernel": Wh, "bias": bih},
+            "hz": {"kernel": Uz}, "hr": {"kernel": Ur},
+            "hn": {"kernel": Uh, "bias": bhh}}
+
+
 def _consume_layers(named_layers, take, params, batch_stats):
     """Shared weight-consumption walk for the sequential and graph
     families (keras lists arrays per layer in creation order)."""
     for name, layer in named_layers:
         kind = layer["kind"]
-        if kind in ("dense", "conv2d"):
+        if kind in ("dense", "conv2d", "conv1d"):
             entry = {"kernel": take()}
             if layer["use_bias"]:
                 entry["bias"] = take()
             params[name] = entry
+        elif kind == "sepconv2d":
+            dw = take()  # [k, k, in, mult] -> flax group-conv layout
+            k1, k2, cin, mult = dw.shape
+            params[name + "_dw"] = {
+                "kernel": dw.reshape(k1, k2, 1, cin * mult)}
+            pw = {"kernel": take()}
+            if layer["use_bias"]:
+                pw["bias"] = take()
+            params[name + "_pw"] = pw
         elif kind == "embedding":
             params[name] = {"embedding": take()}
         elif kind == "batchnorm":
@@ -698,6 +957,17 @@ def _consume_layers(named_layers, take, params, batch_stats):
                 take(), take(), take())
             params[name + "_bwd"] = _lstm_cell_params(
                 take(), take(), take())
+        elif kind == "gru":
+            params[name] = _gru_cell_params(take(), take(), take())
+        elif kind == "bigru":
+            params[name + "_fwd"] = _gru_cell_params(
+                take(), take(), take())
+            params[name + "_bwd"] = _gru_cell_params(
+                take(), take(), take())
+        elif kind == "simple_rnn":
+            params[name] = {"i": {"kernel": take()},
+                            "h": {"kernel": take()}}
+            params[name]["i"]["bias"] = take()
 
 
 def from_keras_json(arch_json: str,
@@ -776,14 +1046,17 @@ def _graph_spec(graph, arch, weights, input_shape, dtype):
                  if any(i in input_ids for i in n["inputs"])]
     input_dtype = ("int32" if consumers and all(
         n["kind"] == "embedding" for n in consumers) else "float32")
+    kwargs = {"nodes": tuple(graph["nodes"]),
+              "topo": tuple(graph["topo"]),
+              "output": graph["outputs"][0],
+              "input_slices": tuple(tuple(s) for s in
+                                    graph["input_slices"]),
+              "dtype": dtype}
+    if len(graph["outputs"]) > 1:
+        kwargs["outputs"] = tuple(graph["outputs"])
     spec = ModelSpec(
         family="keras_graph",
-        kwargs={"nodes": tuple(graph["nodes"]),
-                "topo": tuple(graph["topo"]),
-                "output": graph["output"],
-                "input_slices": tuple(tuple(s) for s in
-                                      graph["input_slices"]),
-                "dtype": dtype},
+        kwargs=kwargs,
         input_shape=tuple(int(d) for d in input_shape),
         input_dtype=input_dtype)
     variables = (None if weights is None

@@ -94,8 +94,8 @@ def test_batchnorm_and_embedding_mapping():
 
 def test_unsupported_layer_raises_by_name():
     arch = {"class_name": "Sequential", "config": {"layers": [
-        {"class_name": "GRU", "config": {"units": 8}}]}}
-    with pytest.raises(NotImplementedError, match="GRU"):
+        {"class_name": "ConvLSTM2D", "config": {"filters": 8}}]}}
+    with pytest.raises(NotImplementedError, match="ConvLSTM2D"):
         from_keras_json(json.dumps(arch), input_shape=(5, 3))
 
 
@@ -252,19 +252,6 @@ def test_ingested_dag_trains():
 
 
 def test_functional_still_rejected_cases():
-    # multi-output
-    inp = keras.Input((4,))
-    h = keras.layers.Dense(4)(inp)
-    m = keras.Model(inp, [h, keras.layers.Dense(2)(h)])
-    with pytest.raises(NotImplementedError, match="multi-output"):
-        from_keras(m)
-    # shared layer (called twice)
-    inp2 = keras.Input((4,))
-    shared = keras.layers.Dense(4, name="shared")
-    out2 = keras.layers.Add()([shared(inp2), shared(inp2)])
-    m2 = keras.Model(inp2, keras.layers.Dense(2)(out2))
-    with pytest.raises(NotImplementedError, match="shared"):
-        from_keras(m2)
     # multi-input with a non-rank-1 input
     a = keras.Input((4, 4, 1), name="img")
     b = keras.Input((3,), name="vec")
@@ -273,6 +260,54 @@ def test_functional_still_rejected_cases():
     m3 = keras.Model([a, b], keras.layers.Dense(2)(join))
     with pytest.raises(NotImplementedError, match="rank-1"):
         from_keras(m3)
+
+
+def test_shared_layer_weight_reuse_parity(_f32_matmuls):
+    """A layer called twice lowers to one flax module applied at two
+    graph nodes — one parameter set, exact forward parity."""
+    inp = keras.Input((4,))
+    shared = keras.layers.Dense(4, activation="tanh", name="enc")
+    once = shared(inp)
+    twice = shared(once)           # same weights, different input
+    out = keras.layers.Dense(2)(keras.layers.Add()([once, twice]))
+    m = keras.Model(inp, out)
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_graph"
+    # one parameter set for the shared layer, not two: enc + head only
+    assert len(variables["params"]) == 2
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_shared_encoder_two_head_parity(_f32_matmuls):
+    """Shared encoder + two output heads: the forward returns a tuple
+    in output_layers order, each head matching live keras."""
+    inp = keras.Input((6,))
+    enc = keras.layers.Dense(8, activation="relu", name="enc")(inp)
+    head_a = keras.layers.Dense(3, name="class_head")(enc)
+    head_b = keras.layers.Dense(1, name="reg_head")(enc)
+    m = keras.Model(inp, [head_a, head_b])
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_graph"
+    x = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+    got = spec.build().apply(variables, x)
+    want = m(x)
+    assert isinstance(got, tuple) and len(got) == 2
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_multi_output_training_rejected_loudly():
+    inp = keras.Input((4,))
+    h = keras.layers.Dense(4)(inp)
+    m = keras.Model(inp, [h, keras.layers.Dense(2)(h)])
+    spec, variables = from_keras(m)  # ingestion itself succeeds
+    with pytest.raises(NotImplementedError, match="multi-output"):
+        SingleTrainer(spec.to_config(), batch_size=8, num_epoch=1,
+                      learning_rate=0.1)
 
 
 def test_keras2_era_functional_json_parses():
@@ -400,6 +435,96 @@ def test_lstm_forward_parity_with_keras(maker, shape):
     want = np.asarray(m(x))
     got = np.asarray(spec.build().apply(variables, x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def _keras_gru():
+    return keras.Sequential([
+        keras.layers.Input((11,)),
+        keras.layers.Embedding(40, 6),
+        keras.layers.GRU(5),
+        keras.layers.Dense(2),
+    ])
+
+
+def _keras_gru_stack():
+    return keras.Sequential([
+        keras.layers.Input((9,)),
+        keras.layers.Embedding(30, 4),
+        keras.layers.GRU(4, return_sequences=True),
+        keras.layers.Bidirectional(keras.layers.GRU(3)),
+        keras.layers.Dense(2),
+    ])
+
+
+def _keras_simple_rnn():
+    return keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Embedding(25, 4),
+        keras.layers.SimpleRNN(6, activation="tanh"),
+        keras.layers.Dense(2),
+    ])
+
+
+@pytest.mark.parametrize("maker,shape,vocab", [
+    (_keras_gru, (11,), 40),
+    (_keras_gru_stack, (9,), 30),
+    (_keras_simple_rnn, (8,), 25),
+])
+def test_gru_simplernn_forward_parity(maker, shape, vocab):
+    """GRU (keras reset_after=True == flax GRUCell with folded gate
+    biases), Bidirectional(GRU), and SimpleRNN: exact forward parity
+    with live keras."""
+    m = maker()
+    spec, variables = from_keras(m)
+    rng = np.random.default_rng(7)
+    x = rng.integers(1, vocab, size=(4, *shape)).astype(np.int32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_reset_after_false_rejected():
+    with pytest.raises(NotImplementedError, match="reset_after"):
+        from_keras(keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Embedding(10, 4),
+            keras.layers.GRU(3, reset_after=False),
+        ]))
+
+
+def test_conv1d_separable_forward_parity(_f32_matmuls):
+    """Conv1D over sequences and SeparableConv2D (depthwise grouped
+    conv + pointwise, keras weight layout re-folded)."""
+    m1 = keras.Sequential([
+        keras.layers.Input((16, 3)),
+        keras.layers.Conv1D(6, 4, strides=2, padding="same",
+                            activation="relu"),
+        keras.layers.Conv1D(4, 3, padding="valid"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    spec, variables = from_keras(m1)
+    x = np.random.default_rng(5).normal(size=(4, 16, 3)).astype(
+        np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m1(x)), rtol=1e-4, atol=1e-5)
+
+    m2 = keras.Sequential([
+        keras.layers.Input((10, 10, 3)),
+        keras.layers.SeparableConv2D(8, 3, padding="same",
+                                     depth_multiplier=2,
+                                     activation="relu"),
+        keras.layers.SeparableConv2D(4, 3, strides=2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    spec2, v2 = from_keras(m2)
+    x2 = np.random.default_rng(6).normal(size=(2, 10, 10, 3)).astype(
+        np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec2.build().apply(v2, x2)),
+        np.asarray(m2(x2)), rtol=1e-4, atol=1e-4)
 
 
 def test_lstm_unsupported_variants_raise():
