@@ -171,9 +171,15 @@ class TransformerLM(nn.Module):
     dtype: str = "bfloat16"
     attn_fn: Optional[AttnFn] = None  # None -> dense causal / ring
     seq_axis: Optional[str] = None
-    # within-device q block length for ring attention (None = full
-    # block); see parallel.ring_attention.ring_attention(q_chunk=)
+    # within-device q block length for ring/blockwise attention (None =
+    # full block); see parallel.ring_attention.ring_attention(q_chunk=)
     attn_q_chunk: Optional[int] = None
+    #: single-device flash-style attention (JSON-able spelling of
+    #: attn_fn=blockwise_attn_fn(...)): online-softmax q-chunking, the
+    #: [T, T] logits never materialize — the long-T device-local path
+    #: (PERF.md §13).  q chunk length = attn_q_chunk (default 128, the
+    #: measured v5e optimum).
+    blockwise_attn: bool = False
     # >0 replaces every block's MLP with a mixture-of-experts FFN
     # (dense einsum form — shard the expert axes via the TP rules for
     # expert parallelism); the load-balance aux loss rides the
@@ -208,6 +214,12 @@ class TransformerLM(nn.Module):
         else:
             t_global = t
             positions = jnp.arange(t)[None, :]
+            if attn_fn is None and self.blockwise_attn:
+                from distkeras_tpu.parallel.ring_attention import \
+                    blockwise_attn_fn
+
+                attn_fn = blockwise_attn_fn(
+                    q_chunk=self.attn_q_chunk or 128)
         if t_global > self.max_len:
             raise ValueError(
                 f"sequence length {t_global} exceeds "
@@ -218,7 +230,7 @@ class TransformerLM(nn.Module):
         x = x + pos
         if self.scan_blocks:
             if (self.num_experts > 0 or self.attn_fn is not None
-                    or self.seq_axis is not None):
+                    or self.seq_axis is not None or self.blockwise_attn):
                 raise ValueError(
                     "scan_blocks=True supports the dense-attention, "
                     "dense-FFN transformer only (MoE / custom attn / "

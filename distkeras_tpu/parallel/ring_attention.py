@@ -41,8 +41,13 @@ from jax import lax
 _NEG = np.float32(-1e30)
 
 
-def _ring(axis_name: str):
-    """The one-hop-backward permutation (block s lands on device s-1)."""
+def _ring(axis_name: str | None):
+    """The one-hop-backward permutation (block s lands on device s-1).
+    ``axis_name=None`` is the DEVICE-LOCAL degenerate ring (n=1, no
+    hops): the same online-softmax / recompute machinery runs as a
+    single-chip blockwise (flash-style) attention."""
+    if axis_name is None:
+        return 1, 0, None
     n = lax.axis_size(axis_name)
     return n, lax.axis_index(axis_name), [(i, (i - 1) % n)
                                           for i in range(n)]
@@ -51,6 +56,8 @@ def _ring(axis_name: str):
 def _vary(axis_name, trees):
     """Mark zero-initialized scan carries as device-varying (scan's
     carry typing must agree with the computed, varying outputs)."""
+    if axis_name is None:
+        return tuple(trees)
     return tuple(lax.pcast(x, (axis_name,), to="varying") for x in trees)
 
 
@@ -131,9 +138,11 @@ def _forward_scan(q, k, v, axis_name, scale, causal, q_chunk=None):
 
         m, l, acc = lax.map(chunk, (q_ch, pos_ch, m, l, acc))
         # Rotate (the hop after the last step restores the original
-        # placement, which keeps the scan carry shape uniform).
-        k_blk = lax.ppermute(k_blk, axis_name, ring)
-        v_blk = lax.ppermute(v_blk, axis_name, ring)
+        # placement, which keeps the scan carry shape uniform).  The
+        # device-local mode (ring=None, n=1) has nowhere to rotate to.
+        if ring is not None:
+            k_blk = lax.ppermute(k_blk, axis_name, ring)
+            v_blk = lax.ppermute(v_blk, axis_name, ring)
         return (k_blk, v_blk, m, l, acc), None
 
     init = (k, v, *_vary(axis_name, (
@@ -216,10 +225,11 @@ def _bwd(axis_name, scale, causal, q_chunk, residuals, dout):
         (dk, dv), dq = lax.scan(
             chunk, (dk, dv),
             (q_ch, pos_ch, dout_ch, lse_ch, d_ch, dq))
-        k_blk = lax.ppermute(k_blk, axis_name, ring)
-        v_blk = lax.ppermute(v_blk, axis_name, ring)
-        dk = lax.ppermute(dk, axis_name, ring)
-        dv = lax.ppermute(dv, axis_name, ring)
+        if ring is not None:
+            k_blk = lax.ppermute(k_blk, axis_name, ring)
+            v_blk = lax.ppermute(v_blk, axis_name, ring)
+            dk = lax.ppermute(dk, axis_name, ring)
+            dv = lax.ppermute(dv, axis_name, ring)
         return (k_blk, v_blk, dk, dv, dq), None
 
     zeros_kv = jnp.zeros((b, t_local, h, d), jnp.float32)
@@ -278,6 +288,30 @@ def ring_attn_fn(axis_name: str, causal: bool = True,
     mesh axis: ``fn(q, k, v, *, scale)``."""
     return functools.partial(ring_attention, axis_name=axis_name,
                              causal=causal, q_chunk=q_chunk)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        scale: float | None = None, causal: bool = True,
+                        q_chunk: int | None = None) -> jax.Array:
+    """Single-device flash-style attention: the ring machinery with no
+    ring (n=1, no collectives).  The online-softmax q-chunking bounds
+    the transient logits block to ``[B, H, q_chunk, T]`` and the custom
+    VJP recomputes per-chunk probabilities from the saved logsumexp, so
+    the ``[T, T]`` attention matrix is never materialized in either
+    pass — the device-local answer to the dense path's quadratic HBM
+    traffic at long T (PERF.md §13).  Numerics match
+    ``dense_causal_attention`` up to f32 reduction order."""
+    return ring_attention(q, k, v, axis_name=None, scale=scale,
+                          causal=causal, q_chunk=q_chunk)
+
+
+def blockwise_attn_fn(causal: bool = True, q_chunk: int | None = 128):
+    """An ``AttnFn`` for ``TransformerLM(attn_fn=...)`` running
+    device-local blockwise attention.  ``q_chunk=128`` is the measured
+    optimum of the round-4 sweep on the v5e (PERF.md §13: 64/128/256/
+    512 -> 0.370/0.388/0.325/0.231 6ND MFU at T=2048)."""
+    return functools.partial(blockwise_attention, causal=causal,
+                             q_chunk=q_chunk)
 
 
 def sequence_sharded_apply(fn, mesh, seq_axis: str, *,

@@ -100,10 +100,45 @@ def part1_raw_throughput(center, n_params, commits=8, workers_list=(1, 2, 4, 8))
             assert len(done) == workers
 
 
+class _PSCallClock:
+    """Context manager instrumenting ``PSClient.pull/commit`` wall time
+    (worker threads race on the accumulators; lock-protected)."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        from distkeras_tpu.parallel import host_ps
+
+        self._mod = host_ps
+        self._orig = (host_ps.PSClient.pull, host_ps.PSClient.commit)
+
+        def timed(fn):
+            def inner(s, *a, **k):
+                t0 = time.perf_counter()
+                out = fn(s, *a, **k)
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self.t += dt
+                    self.n += 1
+                return out
+            return inner
+
+        host_ps.PSClient.pull = timed(self._orig[0])
+        host_ps.PSClient.commit = timed(self._orig[1])
+        return self
+
+    def __exit__(self, *exc):
+        self._mod.PSClient.pull = self._orig[0]
+        self._mod.PSClient.commit = self._orig[1]
+        return False
+
+
 def part2_e2e_stall(rows=256, workers=4):
     from distkeras_tpu.data import datasets
     from distkeras_tpu.models import model_config
-    from distkeras_tpu.parallel import host_ps
     from distkeras_tpu.trainers import DOWNPOUR
 
     cfg = model_config("resnet", (32, 32, 3), num_classes=10,
@@ -116,26 +151,7 @@ def part2_e2e_stall(rows=256, workers=4):
             rows_w = max(rows, 2 * workers * 8 * window)
             data = datasets.synthetic_classification(
                 rows_w, (32, 32, 3), 10, seed=0)
-            acc = {"t": 0.0, "n": 0}
-            acc_lock = threading.Lock()
-            orig_pull = host_ps.PSClient.pull
-            orig_commit = host_ps.PSClient.commit
-
-            def timed(fn):
-                def inner(self, *a, **k):
-                    t0 = time.perf_counter()
-                    out = fn(self, *a, **k)
-                    dt = time.perf_counter() - t0
-                    # worker threads race on these accumulators
-                    with acc_lock:
-                        acc["t"] += dt
-                        acc["n"] += 1
-                    return out
-                return inner
-
-            host_ps.PSClient.pull = timed(orig_pull)
-            host_ps.PSClient.commit = timed(orig_commit)
-            try:
+            with _PSCallClock() as acc:
                 t = DOWNPOUR(cfg, num_workers=workers,
                              communication_window=window,
                              batch_size=8, num_epoch=1,
@@ -145,17 +161,14 @@ def part2_e2e_stall(rows=256, workers=4):
                 t0 = time.perf_counter()
                 t.train(data)
                 wall = time.perf_counter() - t0
-            finally:
-                host_ps.PSClient.pull = orig_pull
-                host_ps.PSClient.commit = orig_commit
             wire = sum(t.history.get("commit_wire_bytes", []))
             out = {
                 "bench": "e2e", "wire": codec or "raw",
                 "window": window,
                 "rows": rows_w,
                 "rows_per_sec": round(rows_w / wall, 1),
-                "ps_calls": acc["n"],
-                "stall_fraction": round(acc["t"] / (workers * wall), 3),
+                "ps_calls": acc.n,
+                "stall_fraction": round(acc.t / (workers * wall), 3),
                 "epoch_loss": round(t.history["epoch_loss"][-1], 3),
             }
             if wire:  # only the compressed arm tracks wire bytes
@@ -163,20 +176,109 @@ def part2_e2e_stall(rows=256, workers=4):
             print(json.dumps(out), flush=True)
 
 
+def part3_cross_host(window=16, workers=4, rows=None):
+    """Part 3 — the §12 recipe validated across REAL processes: a
+    2-process jax.distributed cluster (PS on process 0, the DCN arm over
+    real TCP), DOWNPOUR host/socket at ResNet-18@32px, window 16,
+    raw vs int8 wire.  Reports global commits/s and per-process stall
+    fraction."""
+    from distkeras_tpu.deploy import run_multiprocess
+
+    for codec in ("raw", "int8"):
+        results = run_multiprocess(
+            __file__, 2,
+            args=["--part", "child", "--codec", codec,
+                  "--window", str(window), "--workers", str(workers),
+                  *(("--rows", str(rows)) if rows else ())],
+            env={"JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+            timeout_s=1800.0)
+        per_proc = [json.loads(r.stdout.strip().splitlines()[-1])
+                    for r in results]
+        wall = max(p["wall_s"] for p in per_proc)
+        commits = per_proc[0]["commits"]  # telemetry is broadcast
+        out = {
+            "bench": "cross_host", "wire": codec, "window": window,
+            "workers": workers, "processes": 2,
+            "rows": per_proc[0]["rows"],
+            "commits": commits,
+            "commits_per_sec": round(commits / wall, 2),
+            "rows_per_sec": round(per_proc[0]["rows"] / wall, 1),
+            "stall_fraction_per_proc": [p["stall_fraction"]
+                                        for p in per_proc],
+            "epoch_loss": per_proc[0]["epoch_loss"],
+        }
+        wire_mb = per_proc[0].get("commit_wire_mb")
+        if wire_mb:
+            out["commit_wire_mb"] = wire_mb
+        print(json.dumps(out), flush=True)
+
+
+def part3_child(args):
+    """One process of the cross-host arm (invoked by part3 via
+    run_multiprocess)."""
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    mesh_lib.initialize_cluster()
+    workers = args.workers
+    window = args.window
+    rows = args.rows or max(512, 2 * workers * 8 * window)
+    data = datasets.synthetic_classification(rows, (32, 32, 3), 10,
+                                             seed=0)
+    cfg = model_config("resnet", (32, 32, 3), num_classes=10,
+                       stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                       dtype="float32")
+    codec = None if args.codec == "raw" else args.codec
+    local_workers = workers // jax.process_count()
+    with _PSCallClock() as acc:
+        t = DOWNPOUR(cfg, num_workers=workers,
+                     communication_window=window, batch_size=8,
+                     num_epoch=1, learning_rate=0.01, seed=0,
+                     fidelity="host", transport="socket",
+                     compression=codec)
+        t0 = time.perf_counter()
+        t.train(data)
+        wall = time.perf_counter() - t0
+    wire = sum(t.history.get("commit_wire_bytes", []))
+    out = {
+        "process": jax.process_index(),
+        "rows": rows,
+        "wall_s": round(wall, 3),
+        "commits": len(t.history["staleness"][-1]),
+        "stall_fraction": round(acc.t / (local_workers * wall), 3),
+        "epoch_loss": round(t.history["epoch_loss"][-1], 3),
+    }
+    if wire:
+        out["commit_wire_mb"] = round(wire / 1e6, 1)
+    print(json.dumps(out), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--commits", type=int, default=8)
-    ap.add_argument("--rows", type=int, default=256)
-    ap.add_argument("--part", choices=["1", "2", "both"],
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--part", choices=["1", "2", "3", "both", "child"],
                     default="both")
+    ap.add_argument("--window", type=int, default=16)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--codec", default="raw")
     args = ap.parse_args()
+    if args.part == "child":
+        part3_child(args)
+        return
     center, n = resnet18_center()
     print(json.dumps({"model": "resnet18", "params": n,
                       "raw_mb": round(4 * n / 1e6, 1)}), flush=True)
     if args.part in ("1", "both"):
         part1_raw_throughput(center, n, commits=args.commits)
     if args.part in ("2", "both"):
-        part2_e2e_stall(rows=args.rows)
+        part2_e2e_stall(rows=args.rows or 256)
+    if args.part == "3":
+        part3_cross_host(window=args.window, workers=args.workers,
+                         rows=args.rows)
 
 
 if __name__ == "__main__":

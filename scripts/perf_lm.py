@@ -15,7 +15,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
+import sys
 import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +39,13 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--attn", choices=["dense", "blockwise"],
+                    default="dense",
+                    help="'blockwise': device-local flash-style "
+                         "attention (online-softmax q-chunks, no "
+                         "[T,T] materialization) — the long-T lever "
+                         "PERF.md §13 measures")
+    ap.add_argument("--q-chunk", type=int, default=128)
     args = ap.parse_args()
 
     from distkeras_tpu.models import ModelSpec, model_config
@@ -43,7 +56,10 @@ def main():
         "transformer_lm", (args.seq_len,), input_dtype="int32",
         vocab_size=args.vocab, num_layers=args.layers,
         d_model=args.d_model, num_heads=args.heads,
-        max_len=args.seq_len, dtype="bfloat16")
+        max_len=args.seq_len, dtype="bfloat16",
+        blockwise_attn=args.attn == "blockwise",
+        attn_q_chunk=(args.q_chunk if args.attn == "blockwise"
+                      else None))
     model = ModelSpec.from_config(spec).build()
     tx = resolve_optimizer("adam", 3e-4)
     tokens = jnp.zeros((args.batch, args.seq_len), jnp.int32)
@@ -73,6 +89,7 @@ def main():
     peak, known = peak_flops(jax.devices()[0])
     print(json.dumps({
         "model": f"lm L{args.layers} d{args.d_model} T{args.seq_len}",
+        "attn": args.attn,
         "params_m": round(n_params / 1e6, 1),
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(toks / dt, 1),

@@ -1,0 +1,122 @@
+"""PS-family flagship throughput: the emulated-fidelity async round on
+the TPU (VERDICT r3 #6).
+
+BASELINE.json's north star is *AEASGD* on ResNet-50, but every prior
+flagship number timed only the bare synchronous step.  This measures
+the thing the PS family actually executes on-device: one emulated
+commit round — ``communication_window`` jitted train steps per worker
+(workers vmapped over the chip / sharded over a mesh) followed by the
+``UpdateRule`` commits in permuted order (design 5b: the PS as XLA
+collective state, no tunnel/host round-trip) — with the same
+scalar-fetch sync and analytic-FLOPs MFU as ``bench.py``.
+
+Run on the TPU:  python scripts/perf_ps_flagship.py
+                 [--trainer aeasgd|adag|downpour|dynsgd]
+                 [--workers 4 --window 2 --batch 32 --image 224]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trainer", default="aeasgd",
+                    choices=["adag", "aeasgd", "downpour", "dynsgd"])
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=32,
+                    help="per-worker batch")
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.parallel.ps_emulator import make_round_fn
+    from distkeras_tpu.profiling import (host_sync, peak_flops,
+                                         resnet50_model_flops)
+    from distkeras_tpu.trainers import ADAG, AEASGD, DOWNPOUR, DynSGD
+    from distkeras_tpu.workers import TrainState, make_train_step
+
+    cls = {"adag": ADAG, "aeasgd": AEASGD, "downpour": DOWNPOUR,
+           "dynsgd": DynSGD}[args.trainer]
+    cfg = model_config("resnet", (args.image, args.image, 3),
+                       num_classes=args.classes,
+                       stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                       stem="space_to_depth")
+    t = cls(cfg, num_workers=args.workers,
+            communication_window=args.window, batch_size=args.batch,
+            learning_rate=0.1, worker_optimizer="momentum", seed=0)
+
+    rule = t.allocate_rule()
+    tx = t._tx()
+    variables = t.model.init(
+        jax.random.key(0),
+        jnp.ones((2, args.image, args.image, 3), jnp.float32))
+    center = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+
+    def make_worker(rng):
+        return TrainState.create({"params": center, **model_state},
+                                 tx, rng)
+
+    worker_keys = jax.random.split(jax.random.key(1), args.workers)
+    worker_states = jax.vmap(make_worker)(worker_keys)
+    step = make_train_step(t.model, t.loss, tx)
+    round_fn = make_round_fn(rule, step, "faithful")
+    ps_state = rule.init_state(center)
+    round_jit = jax.jit(round_fn, donate_argnums=(0, 1))
+
+    # [W, window, B, H, W, C] device batch — what the emulated arm
+    # feeds each round
+    x = jnp.ones((args.workers, args.window, args.batch,
+                  args.image, args.image, 3), jnp.float32)
+    y = jnp.zeros((args.workers, args.window, args.batch), jnp.int32)
+    batch = {"features": x, "label": y}
+    perm = jnp.arange(args.workers)
+
+    for _ in range(3):
+        ps_state, worker_states, metrics = round_jit(
+            ps_state, worker_states, batch, perm)
+    host_sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        ps_state, worker_states, metrics = round_jit(
+            ps_state, worker_states, batch, perm)
+    val = host_sync(metrics["loss"])
+    dt = (time.perf_counter() - t0) / args.reps
+
+    imgs = args.workers * args.window * args.batch
+    flops = resnet50_model_flops(imgs, args.image)
+    peak, known = peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": f"{args.trainer}_resnet50_emulated_round",
+        "images_per_sec": round(imgs / dt, 2),
+        "mfu": round(flops / dt / peak, 4) if known else None,
+        "round_ms": round(dt * 1e3, 2),
+        "per_step_ms": round(dt * 1e3 / args.window, 2),
+        "workers": args.workers, "window": args.window,
+        "batch_per_worker": args.batch,
+        "global_images_per_round": imgs,
+        "image": args.image,
+        "loss_finite": bool(np.isfinite(val)),
+    }))
+
+
+if __name__ == "__main__":
+    main()
