@@ -26,8 +26,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
+import sys
 import threading
 import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
 import jax
 import numpy as np
