@@ -31,10 +31,10 @@ layers (called more than once) lower to one flax module applied at
 every call node — one parameter set, keras's own sharing semantics;
 multi-OUTPUT models forward as a tuple in ``output_layers`` order
 (trainers reject them loudly — per-output losses are not supported);
-multi-input models whose inputs are all rank-1 ingest as ONE
-concatenated features array with per-input column slices (the
-reference-era Wide&Deep shape).  Higher-rank multi-input still
-raises.
+multi-input models ingest as ONE flattened, concatenated features
+array with per-input column slices (the reference-era Wide&Deep
+shape); rank > 1 inputs (an image branch beside a feature branch)
+reshape their slice back to the recorded per-sample shape.
 """
 
 from __future__ import annotations
@@ -391,11 +391,12 @@ def _parse_functional(arch: Mapping[str, Any]) -> dict:
     all calls applying one parameter set); MULTI-OUTPUT models (the
     forward returns a tuple in ``output_layers`` order — trainers that
     need a single loss head reject them loudly).  Multi-INPUT models
-    ingest when every input is rank-1 ``[None, d]``: the inputs
-    concatenate (in ``input_layers`` order) into one features array
-    and each Input node slices its columns back out — the
-    reference-era Wide&Deep shape; higher-rank multi-input is still
-    rejected loudly."""
+    of any rank: the inputs flatten and concatenate (in
+    ``input_layers`` order) into one features array; each Input node
+    slices its columns back out and rank > 1 inputs reshape to their
+    recorded per-sample shape — the reference-era Wide&Deep shape,
+    extended to mixed image/feature branches.  An input whose
+    per-sample shape is unrecorded (None dims) is rejected loudly."""
     config = arch.get("config", {})
     raw_layers = config.get("layers", [])
     if not raw_layers:
@@ -423,8 +424,13 @@ def _parse_functional(arch: Mapping[str, Any]) -> dict:
     if not in_names:
         raise ValueError("functional model declares no input layers")
 
-    # Multi-input: every input must be rank-1; slices concatenate in
-    # input_layers order.
+    # Multi-input: the inputs flatten and concatenate (in input_layers
+    # order) into ONE per-sample feature row; each Input node slices
+    # its columns back out and, for rank > 1 inputs (an image branch
+    # next to a feature branch, say), reshapes them to the recorded
+    # per-sample shape.  This is the columnar-dataset contract every
+    # trainer/predictor already speaks (AssembleTransformer produces
+    # exactly such rows).
     input_slices = []
     if len(in_names) > 1:
         start = 0
@@ -432,14 +438,21 @@ def _parse_functional(arch: Mapping[str, Any]) -> dict:
             cfg_n = by_name[n].get("config", {})
             shape = (cfg_n.get("batch_shape")
                      or cfg_n.get("batch_input_shape"))
-            if shape is None or len(shape) != 2 or shape[1] is None:
+            if (shape is None or len(shape) < 2
+                    or any(d is None for d in shape[1:])):
                 raise NotImplementedError(
-                    f"multi-input ingestion needs every input rank-1 "
-                    f"with a known width ([None, d]); input {n!r} has "
-                    f"batch shape {shape!r} — rebuild natively (e.g. "
-                    f"models.WideDeep for two-branch configs)")
-            width = int(shape[1])
-            input_slices.append([n, start, start + width])
+                    f"multi-input ingestion needs every input's "
+                    f"per-sample shape recorded (no None dims past "
+                    f"the batch); input {n!r} has batch shape "
+                    f"{shape!r}")
+            dims = [int(d) for d in shape[1:]]
+            width = 1
+            for d in dims:
+                width *= d
+            entry = [n, start, start + width]
+            if len(dims) > 1:
+                entry.append(dims)  # rank>1: reshape after slicing
+            input_slices.append(entry)
             start += width
 
     # Call-node ids in config-list order (layers with one call keep
@@ -526,8 +539,8 @@ def _parse_functional(arch: Mapping[str, Any]) -> dict:
         "nodes": nodes,                       # call-id order
         "topo": topo,
         "outputs": [resolve(r, "<output_layers>") for r in out_refs],
-        "input_slices": [[id_of_call[(n, 0)], a, b]
-                         for n, a, b in input_slices],
+        "input_slices": [[id_of_call[(n, 0)], *rest]
+                         for n, *rest in input_slices],
     }
 
 
@@ -776,13 +789,18 @@ class KerasGraph(nn.Module):
 
     ``output`` (int) is the round-3 single-output spelling, still
     honored so serialized round-3 specs and checkpoints load
-    unchanged; ``outputs`` wins when non-empty."""
+    unchanged; ``outputs`` wins when non-empty.
+
+    ``input_slices`` entries are ``(node id, start, end)`` — or
+    ``(node id, start, end, dims)`` for a rank > 1 input, whose
+    columns reshape to the recorded per-sample ``dims`` after slicing
+    (how an image branch rides the flat concatenated row)."""
 
     nodes: Sequence[Mapping[str, Any]] = ()
     topo: Sequence[int] = ()
     output: int = 0
     outputs: Sequence[int] = ()
-    input_slices: Sequence[Sequence[int]] = ()
+    input_slices: Sequence[Sequence[Any]] = ()
     dtype: str = "float32"
 
     @nn.compact
@@ -790,8 +808,10 @@ class KerasGraph(nn.Module):
         dtype = jnp.dtype(self.dtype)
         x = jnp.asarray(x, dtype)
         by_id = {int(n["id"]): n for n in self.nodes}
-        slices = {int(i): (int(a), int(b))
-                  for i, a, b in self.input_slices}
+        slices = {int(s[0]): (int(s[1]), int(s[2]),
+                              tuple(int(d) for d in s[3])
+                              if len(s) > 3 else None)
+                  for s in self.input_slices}
         outs: dict[int, Any] = {}
         memos: dict[int, dict] = {}  # param id -> created submodules
         for nid in self.topo:
@@ -799,8 +819,12 @@ class KerasGraph(nn.Module):
             kind = node["kind"]
             if kind == "input":
                 if int(nid) in slices:
-                    a, b = slices[int(nid)]
-                    outs[int(nid)] = x[..., a:b]
+                    a, b, dims = slices[int(nid)]
+                    piece = x[..., a:b]
+                    if dims is not None:
+                        piece = piece.reshape(
+                            piece.shape[:-1] + dims)
+                    outs[int(nid)] = piece
                 else:
                     outs[int(nid)] = x
                 continue
@@ -1049,8 +1073,10 @@ def _graph_spec(graph, arch, weights, input_shape, dtype):
     kwargs = {"nodes": tuple(graph["nodes"]),
               "topo": tuple(graph["topo"]),
               "output": graph["outputs"][0],
-              "input_slices": tuple(tuple(s) for s in
-                                    graph["input_slices"]),
+              "input_slices": tuple(
+                  tuple(tuple(v) if isinstance(v, list) else v
+                        for v in s)
+                  for s in graph["input_slices"]),
               "dtype": dtype}
     if len(graph["outputs"]) > 1:
         kwargs["outputs"] = tuple(graph["outputs"])

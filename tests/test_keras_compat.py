@@ -251,15 +251,57 @@ def test_ingested_dag_trains():
     assert h[-1] < h[0], h
 
 
-def test_functional_still_rejected_cases():
-    # multi-input with a non-rank-1 input
+def test_multi_input_unrecorded_shape_rejected():
+    """A multi-input model whose input has None dims past the batch
+    axis cannot compute slice widths — it must raise, not ingest a
+    garbage slicing."""
+    arch = {
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"name": "a", "class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, None]},
+                 "inbound_nodes": []},
+                {"name": "b", "class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"name": "cat", "class_name": "Concatenate",
+                 "config": {"axis": -1},
+                 "inbound_nodes": [[["a", 0, 0, {}],
+                                    ["b", 0, 0, {}]]]},
+                {"name": "d", "class_name": "Dense",
+                 "config": {"units": 2},
+                 "inbound_nodes": [[["cat", 0, 0, {}]]]},
+            ],
+            "input_layers": [["a", 0, 0], ["b", 0, 0]],
+            "output_layers": [["d", 0, 0]],
+        },
+    }
+    with pytest.raises(NotImplementedError, match="per-sample shape"):
+        from_keras_json(json.dumps(arch))
+
+
+def test_multi_input_mixed_rank_parity(_f32_matmuls):
+    """An image branch beside a feature branch (mixed-rank
+    multi-input): inputs flatten-concatenate into one feature row;
+    the image slice reshapes back before its convs."""
     a = keras.Input((4, 4, 1), name="img")
     b = keras.Input((3,), name="vec")
-    fa = keras.layers.Flatten()(a)
+    ca = keras.layers.Conv2D(4, 3, padding="same",
+                             activation="relu")(a)
+    fa = keras.layers.Flatten()(ca)
     join = keras.layers.Concatenate()([fa, b])
-    m3 = keras.Model([a, b], keras.layers.Dense(2)(join))
-    with pytest.raises(NotImplementedError, match="rank-1"):
-        from_keras(m3)
+    m = keras.Model([a, b], keras.layers.Dense(2)(join))
+    spec, variables = from_keras(m)
+    assert spec.input_shape == (4 * 4 * 1 + 3,)
+    rng = np.random.default_rng(9)
+    xa = rng.normal(size=(5, 4, 4, 1)).astype(np.float32)
+    xb = rng.normal(size=(5, 3)).astype(np.float32)
+    flat = np.concatenate([xa.reshape(5, -1), xb], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, flat)),
+        np.asarray(m([xa, xb])), rtol=1e-4, atol=1e-5)
 
 
 def test_shared_layer_weight_reuse_parity(_f32_matmuls):
