@@ -269,6 +269,21 @@ def evaluate_model(model, variables: Mapping, dataset: Dataset, *,
     predictor = ModelPredictor(model, variables,
                                features_col=features_col,
                                output="logits", batch_size=batch_size)
+    if predictor.spec is not None and len(
+            predictor.spec.kwargs.get("outputs", ())) > 1:
+        # known multi-output spec: refuse before paying the inference
+        raise NotImplementedError(
+            "evaluate_model needs a single-output model (one logits "
+            "head against one label column); this spec has "
+            f"{len(predictor.spec.kwargs['outputs'])} heads — "
+            "evaluate each via ModelPredictor + metrics_from_logits")
     scored = predictor.predict(dataset)
+    if "prediction" not in scored.column_names:
+        raise NotImplementedError(
+            "evaluate_model needs a single-output model (one logits "
+            "head against one label column); this model produced "
+            f"columns {sorted(scored.column_names)} — evaluate each "
+            "head separately via metrics_from_logits(scored["
+            "'prediction_i'], labels_i)")
     return metrics_from_logits(scored["prediction"],
                                dataset[label_col], top_k=top_k)

@@ -28,6 +28,11 @@ class ModelPredictor:
 
     ``output`` selects the column semantics: ``"logits"``, ``"prob"``
     (softmax), or ``"class"`` (argmax int32).
+
+    A MULTI-OUTPUT model (e.g. an ingested two-head keras DAG —
+    ``compat.keras``) appends one column per head, named
+    ``{output_col}_{i}`` in the model's output order, with the same
+    ``output`` transform applied per head.
     """
 
     def __init__(self, model, variables: Mapping, *,
@@ -79,13 +84,18 @@ class ModelPredictor:
                           and len(devices) >= self.num_shards
                           else None)
 
-        def forward(variables, x):
-            logits = self.model.apply(variables, x, train=False)
+        def transform(logits):
             if self.output == "prob":
                 return jax.nn.softmax(logits, axis=-1)
             if self.output == "class":
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return logits
+
+        def forward(variables, x):
+            out = self.model.apply(variables, x, train=False)
+            if isinstance(out, tuple):  # multi-output head per column
+                return tuple(transform(o) for o in out)
+            return transform(out)
 
         if self._mesh is not None:
             row = NamedSharding(self._mesh, P(mesh_lib.WORKER_AXIS))
@@ -124,9 +134,16 @@ class ModelPredictor:
         x = pad_to_multiple(x, chunk, axis=0)
         outs = []
         for lo in range(0, len(x), chunk):
-            outs.append(np.asarray(
-                self._forward(self.variables, jnp.asarray(
-                    x[lo:lo + chunk]))))
+            out = self._forward(self.variables,
+                                jnp.asarray(x[lo:lo + chunk]))
+            outs.append(tuple(np.asarray(o) for o in out)
+                        if isinstance(out, tuple) else np.asarray(out))
+        if isinstance(outs[0], tuple):
+            for i in range(len(outs[0])):
+                pred = np.concatenate([o[i] for o in outs])[:n]
+                dataset = dataset.with_column(
+                    f"{self.output_col}_{i}", pred)
+            return dataset
         pred = np.concatenate(outs)[:n]
         return dataset.with_column(self.output_col, pred)
 

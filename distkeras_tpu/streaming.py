@@ -57,8 +57,16 @@ class StreamingPredictor(ModelPredictor):
         x = np.stack([np.asarray(r[self.features_col]) for r in rows])
         n = len(x)
         x = pad_to_multiple(x, self.batch_size, axis=0)
-        pred = np.asarray(self._forward(self.variables,
-                                        jnp.asarray(x)))[:n]
+        out = self._forward(self.variables, jnp.asarray(x))
+        if isinstance(out, tuple):
+            # multi-output model: one key per head, mirroring
+            # ModelPredictor's column-per-head contract
+            heads = [np.asarray(o)[:n] for o in out]
+            for i, row in enumerate(rows):
+                yield {**row, **{f"{self.output_col}_{j}": h[i]
+                                 for j, h in enumerate(heads)}}
+            return
+        pred = np.asarray(out)[:n]
         for row, p in zip(rows, pred):
             yield {**row, self.output_col: p}
 

@@ -256,7 +256,8 @@ class Trainer:
                 "multi-output keras models cannot be trained "
                 "(per-output losses are not supported); export a "
                 "single-output submodel per head, or rebuild natively "
-                "with one loss head")
+                "with one loss head.  (Serving works: ModelPredictor "
+                "appends one prediction column per head.)")
         self.model = self.spec.build()
         self.loss = loss
         self.worker_optimizer = worker_optimizer

@@ -47,6 +47,54 @@ def test_predict_handles_ragged_tail():
     assert pred["prediction"].shape == (777, 4)
 
 
+def test_multi_output_model_appends_column_per_head():
+    """An ingested two-head keras DAG predicts one column per output
+    (``prediction_0/1`` in output_layers order) — the serving half of
+    multi-output support (training such specs is rejected loudly)."""
+    import json as _json
+
+    import jax
+
+    from distkeras_tpu.compat import from_keras_json
+    from distkeras_tpu.data import Dataset
+
+    arch = {
+        "class_name": "Model",
+        "config": {
+            "name": "two_head",
+            "layers": [
+                {"name": "in0", "class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None, 4]},
+                 "inbound_nodes": []},
+                {"name": "enc", "class_name": "Dense",
+                 "config": {"units": 5, "activation": "relu"},
+                 "inbound_nodes": [[["in0", 0, 0, {}]]]},
+                {"name": "head_a", "class_name": "Dense",
+                 "config": {"units": 3},
+                 "inbound_nodes": [[["enc", 0, 0, {}]]]},
+                {"name": "head_b", "class_name": "Dense",
+                 "config": {"units": 1},
+                 "inbound_nodes": [[["enc", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in0", 0, 0]],
+            "output_layers": [["head_a", 0, 0], ["head_b", 0, 0]],
+        },
+    }
+    spec, _ = from_keras_json(_json.dumps(arch))
+    x = np.random.default_rng(0).normal(size=(37, 4)).astype(
+        np.float32)
+    variables = spec.build().init(jax.random.key(0), x[:2])
+    data = Dataset({"features": x})
+    out = ModelPredictor(spec, variables, output="logits",
+                         batch_size=16).predict(data)
+    assert out["prediction_0"].shape == (37, 3)
+    assert out["prediction_1"].shape == (37, 1)
+    classes = ModelPredictor(spec, variables, output="class",
+                             batch_size=16).predict(data)
+    assert classes["prediction_0"].dtype == np.int32
+    assert set(np.unique(classes["prediction_0"])) <= {0, 1, 2}
+
+
 def test_multi_shard_prediction_matches_single(devices):
     variables, data = _trained()
     single = ModelPredictor(MLP, variables, num_shards=1,

@@ -100,3 +100,42 @@ def test_streaming_serves_keras_ingested_model():
     want = np.asarray(m(np.stack([r["features"] for r in rows])))
     got = np.stack([r["prediction"] for r in out])
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_serves_multi_output_model():
+    """A two-head ingested DAG streams one key per head
+    (``prediction_0/1``), matching ModelPredictor's column-per-head
+    contract row for row."""
+    import json
+
+    from distkeras_tpu.compat import from_keras_json
+    from distkeras_tpu.data import Dataset
+
+    arch = {"class_name": "Model", "config": {"name": "m", "layers": [
+        {"name": "in0", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 6]},
+         "inbound_nodes": []},
+        {"name": "enc", "class_name": "Dense",
+         "config": {"units": 8, "activation": "relu"},
+         "inbound_nodes": [[["in0", 0, 0, {}]]]},
+        {"name": "a", "class_name": "Dense", "config": {"units": 3},
+         "inbound_nodes": [[["enc", 0, 0, {}]]]},
+        {"name": "b", "class_name": "Dense", "config": {"units": 1},
+         "inbound_nodes": [[["enc", 0, 0, {}]]]},
+    ], "input_layers": [["in0", 0, 0]],
+       "output_layers": [["a", 0, 0], ["b", 0, 0]]}}
+    spec, _ = from_keras_json(json.dumps(arch))
+    variables = spec.build().init(jax.random.key(1),
+                                  np.zeros((2, 6), np.float32))
+    sp = StreamingPredictor(spec, variables, batch_size=16)
+    rows = _rows(37)
+    out = list(sp.predict_stream(iter(rows)))
+    assert len(out) == 37
+    assert out[0]["prediction_0"].shape == (3,)
+    assert out[0]["prediction_1"].shape == (1,)
+    batch = ModelPredictor(spec, variables, batch_size=16).predict(
+        Dataset({"features": np.stack([r["features"]
+                                       for r in rows])}))
+    np.testing.assert_allclose(
+        np.stack([r["prediction_0"] for r in out]),
+        batch["prediction_0"], atol=1e-6)
