@@ -484,10 +484,13 @@ def render_markdown():
             "epochs (adam's large worker steps need a stronger pull — "
             "alpha = lr x rho); (3) the host-thread twins are the one "
             "place recurrent geometry shows RUN-TO-RUN VARIANCE: "
-            "across repeated runs at this exact setting ADAG-host "
-            "landed 0.82 and 0.97 (sync 0.96-0.97), DOWNPOUR-host "
-            "0.92 and 0.96, int8 0.87 and 0.91 — emergent staleness "
-            "schedules differ per run, and the adam transient "
+            "across five repeated runs at this exact setting "
+            "ADAG-host landed 0.81/0.82/0.86/0.95/0.97 (sync "
+            "0.96-0.97; emulated ADAG 0.95, deterministic) and "
+            "DOWNPOUR-host 0.92/0.94/0.94/0.96/0.98 (emulated 0.97); "
+            "int8 0.87 and 0.91 over two runs — emergent staleness "
+            "schedules (mean staleness ~7 commits vs the emulator's "
+            "~3.5 at 8 workers) differ per run, and the adam transient "
             "amplifies them where the MLP/conv geometries (sgd, "
             "flatter window response) did not.  The emulated rows are "
             "deterministic and sit inside the host twins' observed "
