@@ -21,8 +21,11 @@ GlobalAveragePooling2D, Embedding, BatchNormalization, LSTM, GRU
 (``reset_after=True``, the keras >= 2.3 default), SimpleRNN,
 Bidirectional(LSTM|GRU) — the reference's IMDB workflow shape — plus
 the merge layers (Add / Subtract / Multiply / Average / Maximum /
-Concatenate) for functional DAGs.  Anything else raises with the
-layer name so the gap is visible, not silent.
+Concatenate) for functional DAGs, and NESTED ``Sequential`` submodels
+used as layers (inlined; shared nested encoders — the siamese idiom —
+apply one parameter set per call).  Nested functional submodels and
+anything else raise with the layer name so the gap is visible, not
+silent.
 
 Model topologies: ``Sequential``; functional ``Model(inputs,
 outputs)`` graphs — linear chains lower to the ``keras_sequential``
@@ -97,6 +100,25 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
     survive, so the normalized form is stable across keras versions."""
     if class_name == "InputLayer":
         return None
+    if class_name == "Sequential":
+        # a nested Sequential submodel used as a layer — the classic
+        # shared-encoder idiom.  Normalize its layer stack recursively;
+        # apply/weight-consumption walk the sublayers in order.
+        raw = cfg if isinstance(cfg, list) else cfg.get("layers", [])
+        sub = []
+        for entry in raw:
+            norm = _normalize_layer(entry["class_name"],
+                                    entry.get("config", {}))
+            if norm is not None:
+                sub.append(norm)
+        if not sub:
+            raise ValueError("nested Sequential contains no layers")
+        return {"kind": "nested", "layers": sub}
+    if class_name in ("Functional", "Model"):
+        raise NotImplementedError(
+            "nested functional submodels are not supported (nested "
+            "Sequential is); flatten the inner graph into the outer "
+            "model or rebuild natively")
     if class_name == "Dense":
         return {"kind": "dense", "units": int(cfg["units"]),
                 "use_bias": bool(cfg.get("use_bias", True)),
@@ -309,21 +331,46 @@ def _normalize_simple_rnn(cfg: Mapping[str, Any]) -> dict:
                                              False))}
 
 
+def _leading_kind(layer: Mapping[str, Any]) -> str:
+    """First concrete layer kind, descending nested Sequentials — what
+    the input-dtype inference needs to see."""
+    while layer["kind"] == "nested":
+        layer = layer["layers"][0]
+    return layer["kind"]
+
+
 def _infer_input_shape(arch: Mapping[str, Any]) -> tuple[int, ...] | None:
     """Per-sample input shape from the first layer's
     ``batch_shape`` (keras 3) / ``batch_input_shape`` (keras 1/2),
-    when recorded."""
+    when recorded — descending into nested Sequential submodels,
+    where the only recorded shape may live."""
     config = arch.get("config", {})
     raw_layers = (config if isinstance(config, list)
                   else config.get("layers", []))
-    for entry in raw_layers:
-        cfg = entry.get("config", {})
-        shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
-        if shape is not None:
-            if any(d is None for d in shape[1:]):
-                return None  # variable-length dims: caller must pass one
-            return tuple(int(d) for d in shape[1:])
-    return None
+
+    def scan(entries):
+        for entry in entries:
+            cfg = entry.get("config", {})
+            if isinstance(cfg, Mapping):
+                shape = (cfg.get("batch_shape")
+                         or cfg.get("batch_input_shape"))
+                if shape is not None:
+                    return shape
+            sub = (cfg if isinstance(cfg, list)
+                   else cfg.get("layers") if isinstance(cfg, Mapping)
+                   else None)
+            if sub:
+                found = scan(sub)
+                if found is not None:
+                    return found
+        return None
+
+    shape = scan(raw_layers)
+    if shape is None:
+        return None
+    if any(d is None for d in shape[1:]):
+        return None  # variable-length dims: caller must pass one
+    return tuple(int(d) for d in shape[1:])
 
 
 def _inbound_refs(node) -> list[tuple[str, int]]:
@@ -649,6 +696,16 @@ def _apply_layer(layer, name: str, x, dtype, train: bool,
             memo[key] = ctor()
         return memo[key]
 
+    if kind == "nested":
+        # nested Sequential: apply the stack; each sublayer gets its
+        # own name suffix and (when sharing) its own memo slot
+        for i, sub in enumerate(layer["layers"]):
+            sub_memo = None
+            if memo is not None:
+                sub_memo = memo.setdefault(f"s{i}", {})
+            x = _apply_layer(sub, f"{name}_s{i}", x, dtype, train,
+                             memo=sub_memo)
+        return x
     if kind == "dense":
         # contracts the last axis, any rank — keras semantics
         x = get("m", lambda: nn.Dense(
@@ -955,7 +1012,14 @@ def _consume_layers(named_layers, take, params, batch_stats):
     families (keras lists arrays per layer in creation order)."""
     for name, layer in named_layers:
         kind = layer["kind"]
-        if kind in ("dense", "conv2d", "conv1d"):
+        if kind == "nested":
+            # keras lists a nested submodel's arrays in its own layer
+            # order, inline at the submodel's position
+            _consume_layers(
+                [(f"{name}_s{i}", sub)
+                 for i, sub in enumerate(layer["layers"])],
+                take, params, batch_stats)
+        elif kind in ("dense", "conv2d", "conv1d"):
             entry = {"kernel": take()}
             if layer["use_bias"]:
                 entry["bias"] = take()
@@ -1030,7 +1094,7 @@ def from_keras_json(arch_json: str,
             raise ValueError(
                 "the keras JSON records no input shape (the model was "
                 "never built); pass input_shape=")
-    input_dtype = ("int32" if layers[0]["kind"] == "embedding"
+    input_dtype = ("int32" if _leading_kind(layers[0]) == "embedding"
                    else "float32")
     spec = ModelSpec(family="keras_sequential",
                      kwargs={"layers": tuple(layers), "dtype": dtype},
@@ -1069,7 +1133,8 @@ def _graph_spec(graph, arch, weights, input_shape, dtype):
     consumers = [n for n in graph["nodes"]
                  if any(i in input_ids for i in n["inputs"])]
     input_dtype = ("int32" if consumers and all(
-        n["kind"] == "embedding" for n in consumers) else "float32")
+        _leading_kind(n) == "embedding" for n in consumers)
+        else "float32")
     kwargs = {"nodes": tuple(graph["nodes"]),
               "topo": tuple(graph["topo"]),
               "output": graph["outputs"][0],

@@ -251,6 +251,58 @@ def test_ingested_dag_trains():
     assert h[-1] < h[0], h
 
 
+def test_nested_sequential_submodel_parity():
+    """A Sequential used as a layer inside a Sequential ingests by
+    inlining its stack (weights consumed in order)."""
+    inner = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(6, activation="relu"),
+        keras.layers.Dense(6, activation="tanh"),
+    ])
+    outer = keras.Sequential([
+        keras.layers.Input((8,)),
+        inner,
+        keras.layers.Dense(2),
+    ])
+    spec, variables = from_keras(outer)
+    x = np.random.default_rng(2).normal(size=(5, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(outer(x)), rtol=1e-4, atol=1e-5)
+
+
+def test_shared_nested_encoder_siamese_parity(_f32_matmuls):
+    """The classic siamese idiom: one nested Sequential encoder called
+    on two inputs — one parameter set, exact forward parity."""
+    enc = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(6, activation="relu", name="e1"),
+        keras.layers.Dense(6, name="e2"),
+    ])
+    a = keras.Input((4,), name="left")
+    b = keras.Input((4,), name="right")
+    joined = keras.layers.Concatenate()([enc(a), enc(b)])
+    m = keras.Model([a, b], keras.layers.Dense(2)(joined))
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_graph"
+    rng = np.random.default_rng(3)
+    xa = rng.normal(size=(5, 4)).astype(np.float32)
+    xb = rng.normal(size=(5, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(
+            variables, np.concatenate([xa, xb], axis=1))),
+        np.asarray(m([xa, xb])), rtol=1e-5, atol=1e-5)
+
+
+def test_nested_functional_rejected_loudly():
+    inner_in = keras.Input((4,))
+    inner = keras.Model(inner_in, keras.layers.Dense(3)(inner_in))
+    outer_in = keras.Input((4,))
+    m = keras.Model(outer_in, keras.layers.Dense(2)(inner(outer_in)))
+    with pytest.raises(NotImplementedError, match="nested functional"):
+        from_keras(m)
+
+
 def test_multi_input_unrecorded_shape_rejected():
     """A multi-input model whose input has None dims past the batch
     axis cannot compute slice widths — it must raise, not ingest a
