@@ -46,6 +46,13 @@ def main():
                          "[T,T] materialization) — the long-T lever "
                          "PERF.md §13 measures")
     ap.add_argument("--q-chunk", type=int, default=128)
+    ap.add_argument("--experts", type=int, default=0,
+                    help=">0 swaps every block's FFN for a top-1 "
+                         "Switch MoE with this many experts (dense "
+                         "einsum form; runs replicated on one chip).  "
+                         "MFU is computed on ACTIVE params (one "
+                         "expert per token), the number that tracks "
+                         "useful work")
     args = ap.parse_args()
 
     from distkeras_tpu.models import ModelSpec, model_config
@@ -57,6 +64,7 @@ def main():
         vocab_size=args.vocab, num_layers=args.layers,
         d_model=args.d_model, num_heads=args.heads,
         max_len=args.seq_len, dtype="bfloat16",
+        num_experts=args.experts,
         blockwise_attn=args.attn == "blockwise",
         attn_q_chunk=(args.q_chunk if args.attn == "blockwise"
                       else None))
@@ -82,14 +90,23 @@ def main():
     dt = (time.perf_counter() - t0) / args.reps
 
     toks = args.batch * args.seq_len
-    # 6ND (fwd 2ND + bwd 4ND) + attention term 12*L*d*T^2 (fwd+bwd)
-    flops_param = 6.0 * n_params * toks
+    # 6ND (fwd 2ND + bwd 4ND) + attention term 12*L*d*T^2 (fwd+bwd).
+    # MoE: count ACTIVE params — top-1 routing touches one expert's
+    # FFN per token, so (E-1) experts' FFN weights are excluded.
+    n_active = n_params
+    if args.experts > 1:
+        per_expert_ffn = 2 * args.d_model * (args.d_model
+                                             * 4) + args.d_model * 5
+        n_active -= (args.experts - 1) * args.layers * per_expert_ffn
+    flops_param = 6.0 * n_active * toks
     flops_attn = (12.0 * args.layers * args.d_model
                   * args.seq_len * args.seq_len * args.batch)
     peak, known = peak_flops(jax.devices()[0])
     print(json.dumps({
         "model": f"lm L{args.layers} d{args.d_model} T{args.seq_len}",
         "attn": args.attn,
+        "experts": args.experts,
+        "params_active_m": round(n_active / 1e6, 1),
         "params_m": round(n_params / 1e6, 1),
         "step_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(toks / dt, 1),
