@@ -248,16 +248,33 @@ class Trainer:
         ``train()`` in a ``jax.profiler`` trace written there (view
         with TensorBoard / xprof)."""
         self.spec = _resolve_spec(model)
-        if len(self.spec.kwargs.get("outputs", ())) > 1:
-            # ingested multi-output keras DAGs forward fine (tuple
-            # outputs) but training needs per-output losses, which no
-            # trainer consumes — fail here, not deep inside a jit trace
-            raise NotImplementedError(
-                "multi-output keras models cannot be trained "
-                "(per-output losses are not supported); export a "
-                "single-output submodel per head, or rebuild natively "
-                "with one loss head.  (Serving works: ModelPredictor "
-                "appends one prediction column per head.)")
+        n_heads = len(self.spec.kwargs.get("outputs", ()))
+        if n_heads > 1:
+            # multi-output models train with one loss + label column
+            # PER HEAD — validate here, not deep inside a jit trace
+            if not (isinstance(loss, (list, tuple))
+                    and isinstance(label_col, (list, tuple))
+                    and len(loss) == n_heads
+                    and len(label_col) == n_heads):
+                raise ValueError(
+                    f"this model has {n_heads} output heads: pass "
+                    f"loss= and label_col= as sequences of {n_heads} "
+                    f"entries (one loss and one label column per "
+                    f"head); got loss={loss!r}, "
+                    f"label_col={label_col!r}")
+        elif isinstance(loss, (list, tuple)) \
+                or isinstance(label_col, (list, tuple)):
+            # single-head model: unwrap the length-1 sequence spelling
+            # (mirrors the multi-head API), reject anything longer
+            if not (isinstance(loss, (list, tuple))
+                    and isinstance(label_col, (list, tuple))
+                    and len(loss) == 1 and len(label_col) == 1):
+                raise ValueError(
+                    f"this model has one output head; loss= and "
+                    f"label_col= sequences must both have exactly one "
+                    f"entry (got loss={loss!r}, "
+                    f"label_col={label_col!r})")
+            loss, label_col = loss[0], label_col[0]
         self.model = self.spec.build()
         self.loss = loss
         self.worker_optimizer = worker_optimizer
@@ -285,7 +302,10 @@ class Trainer:
         return self.model.init(jax.random.key(self.seed), sample)
 
     def _columns(self) -> list[str]:
-        return [self.features_col, self.label_col]
+        labels = (list(self.label_col)
+                  if isinstance(self.label_col, (list, tuple))
+                  else [self.label_col])
+        return [self.features_col, *labels]
 
     def _record(self, **kwargs):
         for k, v in kwargs.items():
@@ -303,6 +323,13 @@ class Trainer:
         done in-framework)."""
         from distkeras_tpu.profiling import profiler_trace
 
+        if eval_dataset is not None and isinstance(
+                self.label_col, (list, tuple)):
+            raise NotImplementedError(
+                "per-epoch eval_dataset= supports single-head models "
+                "(one prediction column against one label column); "
+                "evaluate a multi-output model per head after "
+                "training via ModelPredictor + ops.metrics")
         self._eval_dataset = eval_dataset
         start = time.time()
         try:

@@ -142,11 +142,52 @@ def make_train_step(model, loss, tx: optax.GradientTransformation,
     Handles dropout rngs and mutable collections (batch_stats) generically;
     pure and jittable, so it can be ``vmap``-ed per worker and ``scan``-ed
     over a communication window.
+
+    MULTI-OUTPUT models (tuple forward — e.g. an ingested two-head
+    keras DAG): pass ``loss`` as a sequence of per-head losses and
+    ``label_col`` as the matching sequence of label columns; the
+    objective is their sum (plus any sown auxiliary losses).
     """
-    loss_fn = resolve_loss(loss)
+    multi = isinstance(loss, (list, tuple))
+    if multi != isinstance(label_col, (list, tuple)):
+        raise ValueError(
+            "loss and label_col must both be sequences (one per "
+            "output head) or both single values; got "
+            f"loss={loss!r}, label_col={label_col!r}")
+    if multi:
+        if len(loss) != len(label_col):
+            raise ValueError(
+                f"{len(loss)} losses vs {len(label_col)} label "
+                f"columns — one of each per output head")
+        head_fns = [resolve_loss(l) for l in loss]
+
+        def loss_fn(logits, ys):
+            if not (isinstance(logits, tuple)
+                    and len(logits) == len(head_fns)):
+                raise ValueError(
+                    f"model produced "
+                    f"{len(logits) if isinstance(logits, tuple) else 1}"
+                    f" output head(s) but {len(head_fns)} losses were "
+                    f"configured")
+            total = jnp.float32(0.0)
+            for fn, lg, y in zip(head_fns, logits, ys):
+                total = total + fn(lg, y)
+            return total
+    else:
+        single_fn = resolve_loss(loss)
+
+        def loss_fn(logits, y):
+            if isinstance(logits, tuple):
+                raise ValueError(
+                    "multi-output model needs a sequence of losses "
+                    "and label columns (one per head); got a single "
+                    "loss")
+            return single_fn(logits, y)
 
     def step(state: TrainState, batch: Mapping[str, jnp.ndarray]):
-        x, y = batch[features_col], batch[label_col]
+        x = batch[features_col]
+        y = (tuple(batch[c] for c in label_col) if multi
+             else batch[label_col])
         rng = jax.random.fold_in(state.rng, state.step)
         # "losses" is ALWAYS mutable — auxiliary objectives sown by
         # modules (e.g. the MoE load-balance loss) must reach the

@@ -394,14 +394,57 @@ def test_shared_encoder_two_head_parity(_f32_matmuls):
                                    rtol=1e-5, atol=1e-5)
 
 
-def test_multi_output_training_rejected_loudly():
+def test_multi_output_training_needs_per_head_losses():
     inp = keras.Input((4,))
     h = keras.layers.Dense(4)(inp)
     m = keras.Model(inp, [h, keras.layers.Dense(2)(h)])
     spec, variables = from_keras(m)  # ingestion itself succeeds
-    with pytest.raises(NotImplementedError, match="multi-output"):
+    with pytest.raises(ValueError, match="output heads"):
         SingleTrainer(spec.to_config(), batch_size=8, num_epoch=1,
-                      learning_rate=0.1)
+                      learning_rate=0.1)  # single loss: rejected
+
+
+def test_multi_output_model_trains_with_per_head_losses():
+    """A two-head ingested DAG trains end-to-end: one loss + one label
+    column per head, objective = their sum."""
+    inp = keras.Input((6,))
+    enc = keras.layers.Dense(16, activation="relu")(inp)
+    class_head = keras.layers.Dense(3, name="cls")(enc)
+    reg_head = keras.layers.Dense(1, name="reg")(enc)
+    m = keras.Model(inp, [class_head, reg_head])
+    spec, variables = from_keras(m)
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(512, 6)).astype(np.float32)
+    w = rng.normal(size=(6,))
+    label_cls = (x @ w > 0).astype(np.int32) + (x[:, 0] > 1)
+    label_reg = (x @ w).astype(np.float32)[:, None]
+    from distkeras_tpu.data.dataset import Dataset
+
+    data = Dataset({"features": x, "cls": label_cls.astype(np.int32),
+                    "reg": label_reg})
+    t = SingleTrainer(
+        spec.to_config(),
+        loss=["sparse_categorical_crossentropy", "mse"],
+        label_col=["cls", "reg"],
+        worker_optimizer="adam", learning_rate=5e-3,
+        batch_size=32, num_epoch=4, seed=0)
+    t.train(data, initial_variables=variables)
+    h = t.history["epoch_loss"]
+    assert np.isfinite(h).all()
+    assert h[-1] < h[0] * 0.8, h
+
+    # the async family consumes the same per-head spelling
+    from distkeras_tpu.trainers import ADAG
+
+    a = ADAG(spec.to_config(),
+             loss=["sparse_categorical_crossentropy", "mse"],
+             label_col=["cls", "reg"], num_workers=4,
+             communication_window=2, worker_optimizer="adam",
+             learning_rate=5e-3, batch_size=16, num_epoch=2, seed=0)
+    a.train(data, initial_variables=variables)
+    ah = a.history["epoch_loss"]
+    assert np.isfinite(ah).all() and ah[-1] < ah[0], ah
 
 
 def test_keras2_era_functional_json_parses():
