@@ -407,3 +407,36 @@ def test_host_arm_records_skipped_runt_shard(tmp_path):
                  fidelity="host")
     t.train(sd)
     assert t.history["skipped_segment_rows"] == [6]
+
+
+def test_prefetch_feeder_exits_when_consumer_abandons():
+    """An abandoned epoch iterator (train error, interrupt) must not
+    leave the daemon feeder blocked holding loaded segments (ADVICE-
+    style leak): closing the generator cancels the feeder."""
+    import threading
+    import time
+
+    from distkeras_tpu.trainers import _prefetch_iter
+
+    started = threading.Event()
+
+    def loads():
+        for i in range(100):
+            started.set()
+            yield np.zeros(4) + i
+
+    before = set(threading.enumerate())
+    it = _prefetch_iter(loads(), depth=1)
+    next(it)
+    assert started.is_set()
+    it.close()  # consumer walks away mid-stream
+    deadline = time.monotonic() + 5.0
+    alive: list = []
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "dkt-segment-prefetch" and t.is_alive()
+                 and t not in before]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, "prefetch feeder still alive after close()"
