@@ -180,6 +180,17 @@ class TransformerLM(nn.Module):
     #: (PERF.md §13).  q chunk length = attn_q_chunk (default 128, the
     #: measured v5e optimum).
     blockwise_attn: bool = False
+    #: hand-written Pallas flash-attention kernels (JSON-able spelling
+    #: of attn_fn=ops.attention.flash_attn_fn()): same online-
+    #: softmax algorithm as blockwise_attn but as one Mosaic kernel per
+    #: pass — accumulators VMEM-resident, k/v blocks pipelined, causal
+    #: blocks grid-skipped.  The fastest long-T path on the v5e
+    #: (PERF.md §17).  Always uses the kernel's measured block defaults
+    #: (512/1024, auto-clamped to divisors of T); attn_q_chunk applies
+    #: to the blockwise/ring paths only — its tuned values (~128) sit
+    #: in the kernel's WORST regime, so it is deliberately not reused
+    #: here.  To tune blocks, pass attn_fn=flash_attn_fn(block_q=...).
+    flash_attn: bool = False
     # >0 replaces every block's MLP with a mixture-of-experts FFN
     # (dense einsum form — shard the expert axes via the TP rules for
     # expert parallelism); the load-balance aux loss rides the
@@ -202,6 +213,18 @@ class TransformerLM(nn.Module):
         tokens = tokens.astype(jnp.int32)
         t = tokens.shape[1]
         attn_fn = self.attn_fn
+        if self.blockwise_attn and self.flash_attn:
+            raise ValueError(
+                "blockwise_attn and flash_attn are mutually exclusive "
+                "spellings of the device-local flash-style attention "
+                "path")
+        if self.seq_axis is not None and (self.blockwise_attn
+                                          or self.flash_attn):
+            raise ValueError(
+                "blockwise_attn/flash_attn are device-local attention "
+                "paths; with seq_axis the attention is ring attention "
+                "over the mesh — use attn_q_chunk to bound its "
+                "within-device blocks instead")
         if self.seq_axis is not None:
             from distkeras_tpu.parallel.ring_attention import ring_attn_fn
 
@@ -220,6 +243,11 @@ class TransformerLM(nn.Module):
 
                 attn_fn = blockwise_attn_fn(
                     q_chunk=self.attn_q_chunk or 128)
+            elif attn_fn is None and self.flash_attn:
+                from distkeras_tpu.ops.attention import \
+                    flash_attn_fn
+
+                attn_fn = flash_attn_fn()
         if t_global > self.max_len:
             raise ValueError(
                 f"sequence length {t_global} exceeds "
@@ -230,7 +258,8 @@ class TransformerLM(nn.Module):
         x = x + pos
         if self.scan_blocks:
             if (self.num_experts > 0 or self.attn_fn is not None
-                    or self.seq_axis is not None or self.blockwise_attn):
+                    or self.seq_axis is not None or self.blockwise_attn
+                    or self.flash_attn):
                 raise ValueError(
                     "scan_blocks=True supports the dense-attention, "
                     "dense-FFN transformer only (MoE / custom attn / "

@@ -13,3 +13,24 @@ from distkeras_tpu.ops.metrics import (  # noqa: F401
     binary_accuracy,
     top_k_accuracy,
 )
+
+# Pallas-backed ops are lazy (module __getattr__), matching the
+# non-re-exported pallas_kernels/fused_block precedent: importing the
+# package must not pull jax.experimental.pallas + Mosaic machinery in
+# for users who never touch a kernel path.
+_LAZY = {"flash_attention", "flash_attn_fn"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        # The submodule is named `attention` precisely so none of its
+        # exported functions collide with a submodule name — the
+        # package attr binding stays stable no matter what was
+        # imported first.
+        from distkeras_tpu.ops import attention as _attn
+
+        for n in _LAZY:
+            globals()[n] = getattr(_attn, n)
+        return globals()[name]
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

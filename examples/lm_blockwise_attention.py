@@ -5,9 +5,11 @@ mesh; this one scales T on a single device: `TransformerLM(
 blockwise_attn=True)` runs the ring path's q-chunked online-softmax
 locally (no collectives), so neither the forward nor the backward ever
 materializes the [T, T] attention matrix — measured +41% tokens/s over
-dense attention at T=2048 on the v5e (PERF.md §13 addendum).  Trains a
-tiny LM with both attentions on the same data and checks they reach
-the same loss (they compute the same function).
+dense attention at T=2048 on the v5e (PERF.md §13 addendum).  The
+hand-written Pallas kernels (`flash_attn=True`, ops/attention)
+run the same algorithm as one Mosaic kernel per pass and are faster
+still (PERF.md §17).  Trains a tiny LM with all three attentions on
+the same data and checks they reach the same loss (same function).
 
 Run:  python examples/lm_blockwise_attention.py
       python examples/lm_blockwise_attention.py --seq-len 256
@@ -49,14 +51,15 @@ def _run(args):
     data = datasets.lm_synth(args.rows, seq_len=args.seq_len,
                              vocab_size=args.vocab_size, seed=0)
 
-    def train(blockwise: bool):
+    def train(attn: str):
         cfg = model_config(
             "transformer_lm", (args.seq_len,), input_dtype="int32",
             vocab_size=args.vocab_size, num_layers=args.layers,
             d_model=args.d_model, num_heads=4,
             max_len=args.seq_len, dtype="float32",
-            blockwise_attn=blockwise,
-            attn_q_chunk=args.q_chunk if blockwise else None)
+            blockwise_attn=attn == "blockwise",
+            flash_attn=attn == "flash",
+            attn_q_chunk=args.q_chunk if attn == "blockwise" else None)
         t = SingleTrainer(cfg, loss="sparse_categorical_crossentropy",
                           worker_optimizer="adam",
                           learning_rate=args.learning_rate,
@@ -65,17 +68,21 @@ def _run(args):
         t.train(data)
         return [round(x, 4) for x in t.history["epoch_loss"]]
 
-    dense = train(blockwise=False)
-    block = train(blockwise=True)
+    dense = train("dense")
+    block = train("blockwise")
+    flash = train("flash")
     print(json.dumps({
         "example": "lm_blockwise_attention",
         "seq_len": args.seq_len,
         "dense_epoch_loss": dense,
         "blockwise_epoch_loss": block,
+        "flash_epoch_loss": flash,
     }))
     # same function, same data, same seed: curves agree to numerics
     assert np.allclose(dense, block, rtol=2e-2, atol=2e-2), (dense,
                                                              block)
+    assert np.allclose(dense, flash, rtol=2e-2, atol=2e-2), (dense,
+                                                             flash)
     assert block[-1] < block[0]
 
 

@@ -39,13 +39,18 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--reps", type=int, default=10)
-    ap.add_argument("--attn", choices=["dense", "blockwise"],
+    ap.add_argument("--attn", choices=["dense", "blockwise", "flash"],
                     default="dense",
                     help="'blockwise': device-local flash-style "
                          "attention (online-softmax q-chunks, no "
                          "[T,T] materialization) — the long-T lever "
-                         "PERF.md §13 measures")
-    ap.add_argument("--q-chunk", type=int, default=128)
+                         "PERF.md §13 measures.  'flash': the same "
+                         "algorithm as hand-written Pallas kernels "
+                         "(ops.attention, PERF.md §17)")
+    ap.add_argument("--q-chunk", type=int, default=128,
+                    help="q block length for --attn blockwise; for "
+                         "--attn flash the kernel's measured default "
+                         "blocks (512/1024) are used")
     ap.add_argument("--experts", type=int, default=0,
                     help=">0 swaps every block's FFN for a top-1 "
                          "Switch MoE with this many experts (dense "
@@ -66,6 +71,7 @@ def main():
         max_len=args.seq_len, dtype="bfloat16",
         num_experts=args.experts,
         blockwise_attn=args.attn == "blockwise",
+        flash_attn=args.attn == "flash",
         attn_q_chunk=(args.q_chunk if args.attn == "blockwise"
                       else None))
     model = ModelSpec.from_config(spec).build()
