@@ -1,0 +1,145 @@
+"""Autoregressive generation for ``TransformerLM`` — the LM family's
+serving path.
+
+The reference predates autoregressive serving entirely (SURVEY.md §0:
+MLP/CNN-era workloads; its ``predictors.py`` is one batched forward per
+row partition), so this surface has no counterpart to mirror — it is
+the natural completion of the rebuild's LM family: training
+(``trainers``), batch scoring (``predictors.ModelPredictor``), and now
+token generation.
+
+TPU-native shape: the prompt is processed in ONE forward pass that
+fills every layer's KV cache (``TransformerLM(decode=True)`` — same
+parameters, ``"cache"`` variable collection), then each new token is a
+T=1 step inside a ``lax.scan``, so the whole generation compiles to a
+single XLA program with static shapes — no per-token Python dispatch,
+no retracing across steps.  Greedy (``temperature=0``), temperature,
+and top-k sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.core import ModelSpec
+from distkeras_tpu.models.transformer import TransformerLM
+
+
+def _decode_model(model) -> TransformerLM:
+    if isinstance(model, Mapping):
+        model = ModelSpec.from_config(model).build()
+    elif isinstance(model, ModelSpec):
+        model = model.build()
+    if not isinstance(model, TransformerLM):
+        raise TypeError(
+            "generate() serves TransformerLM models; got "
+            f"{type(model).__name__}")
+    if model.scan_blocks:
+        raise ValueError(
+            "generate() cannot serve scan_blocks=True models: the "
+            "stacked param layout differs from the per-layer one the "
+            "decode path walks.  Un-stack the params (or train "
+            "without scan_blocks) to serve this model.")
+    if model.num_experts > 0:
+        raise ValueError(
+            "generate() cannot serve MoE models yet: capacity-"
+            "bucketed routing over a T=1 decode step diverges from "
+            "the full-forward routing (different tokens overflow and "
+            "drop), so cached decode would silently differ from what "
+            "the trained model predicts.  Serve via the dense "
+            "full-forward path (predictors) instead.")
+    # flash/blockwise/ring are execution spellings of the SAME
+    # parameters — decode replaces them with cached attention.
+    return model.clone(decode=True, flash_attn=False,
+                       blockwise_attn=False, attn_fn=None,
+                       seq_axis=None)
+
+
+def _select(logits, temperature, top_k, rng):
+    """Next-token choice from ``[B, V]`` logits (f32)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        # lax.top_k for the kth-largest threshold, not a full-vocab
+        # sort — this runs once per decode step
+        kth = lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, variables: Mapping, prompt, *,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_k: int | None = None, rng=None):
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    Args:
+      model: a ``TransformerLM`` (any attention spelling — decode mode
+        replaces it with cached attention), its ``ModelSpec``, or a
+        model config dict.  Parameters are shared with training: pass
+        the trained ``variables`` unchanged.
+      variables: ``{"params": ...}`` as returned by init/training.
+      prompt: ``[B, T_prompt]`` int32 token ids (``T_prompt >= 1``).
+      max_new_tokens: number of tokens to append; ``T_prompt +
+        max_new_tokens`` must fit the model's ``max_len`` (the KV
+        cache and position table size).
+      temperature: 0 = greedy argmax; > 0 = softmax sampling.
+      top_k: optional sampling restriction to the k highest logits.
+      rng: ``jax.random`` key, required when ``temperature > 0``.
+
+    Returns:
+      ``[B, T_prompt + max_new_tokens]`` int32 — prompt + generated.
+
+    Jit-compatible (wrap in ``jax.jit`` with ``max_new_tokens`` etc.
+    closed over); the decode loop is a ``lax.scan`` either way.
+    """
+    dec = _decode_model(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError(
+            f"prompt must be [B, T_prompt>=1]; got {prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1; got {max_new_tokens}")
+    total = prompt.shape[1] + int(max_new_tokens)
+    if total > dec.max_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_len="
+            f"{dec.max_len}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires an rng key")
+    if top_k is not None and not 1 <= top_k <= dec.vocab_size:
+        raise ValueError(
+            f"top_k={top_k} out of range [1, {dec.vocab_size}]")
+    if rng is None:
+        rng = jax.random.key(0)  # unused on the greedy path
+    params = {"params": variables["params"]}
+
+    # One pass over the prompt creates and fills every layer's cache.
+    logits, state = dec.apply(params, prompt, mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    tok = _select(logits[:, -1].astype(jnp.float32), temperature,
+                  top_k, sub)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, state = dec.apply({**params, "cache": cache},
+                                  tok[:, None], mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        nxt = _select(logits[:, -1].astype(jnp.float32), temperature,
+                      top_k, sub)
+        return (state["cache"], nxt, rng), tok
+
+    if max_new_tokens > 1:
+        (_, last, _), toks = lax.scan(
+            step, (state["cache"], tok, rng), None,
+            length=max_new_tokens - 1)
+        new = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    else:
+        new = tok[:, None]
+    return jnp.concatenate([prompt, new], axis=1)

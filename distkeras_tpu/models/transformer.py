@@ -32,12 +32,24 @@ def dense_causal_attention(q, k, v, *, scale):
 
 
 class SelfAttention(nn.Module):
+    """``cache_len > 0`` switches on autoregressive decode mode: K/V
+    projections of every token seen so far persist in a ``"cache"``
+    variable collection (``cached_key``/``cached_value`` sized
+    ``[B, cache_len, H, D]`` plus an insertion ``cache_index``), and
+    each call appends its T tokens and attends back over the whole
+    prefix.  No counterpart in the reference — it predates
+    autoregressive serving entirely (SURVEY.md §0: MLP/CNN-era
+    workloads; predictors are one batched forward)."""
+
     num_heads: int
     dtype: jnp.dtype
     attn_fn: Optional[AttnFn] = None
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
+        import jax.lax as lax
+
         d_model = x.shape[-1]
         if d_model % self.num_heads:
             raise ValueError(
@@ -47,8 +59,42 @@ class SelfAttention(nn.Module):
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.num_heads, head_dim), dtype=self.dtype, name=name)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        attn = self.attn_fn or dense_causal_attention
-        out = attn(q, k, v, scale=head_dim ** -0.5)
+        if self.cache_len > 0:
+            b, t = x.shape[0], x.shape[1]
+            shape = (b, self.cache_len, self.num_heads, head_dim)
+            ck = self.variable("cache", "cached_key", jnp.zeros, shape,
+                               k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               shape, v.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = lax.dynamic_update_slice(ck.value, k,
+                                                (0, idx, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v,
+                                                (0, idx, 0, 0))
+            ci.value = idx + t
+            # q rows sit at global positions idx..idx+t-1; causal mask
+            # over the full cache (future slots are zeros AND masked)
+            q_pos = idx + jnp.arange(t)
+            k_pos = jnp.arange(self.cache_len)
+            mask = k_pos[None, :] <= q_pos[:, None]         # [t, L]
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) \
+                * head_dim ** -0.5
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+            # Overflow is a traced condition (cache_index is dynamic),
+            # so it cannot raise; dynamic_update_slice would silently
+            # CLAMP the write and corrupt the cache.  Poison the
+            # output with NaN instead — loud under jit, and it
+            # propagates to any downstream logit/metric.
+            ok = idx + t <= self.cache_len
+            out = jnp.where(ok, out, jnp.nan)
+        else:
+            attn = self.attn_fn or dense_causal_attention
+            out = attn(q, k, v, scale=head_dim ** -0.5)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                name="out")(out)
 
@@ -118,12 +164,14 @@ class Block(nn.Module):
     num_experts: int = 0  # 0 = dense MLP; >0 = MoE FFN
     expert_capacity_factor: float = 1.25
     expert_top_k: int = 1
+    cache_len: int = 0  # >0 = autoregressive decode (KV cache)
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn)(y)
+        x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn,
+                              cache_len=self.cache_len)(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.num_experts > 0:
             y = MoEFFN(self.num_experts, self.mlp_ratio, self.dtype,
@@ -204,6 +252,19 @@ class TransformerLM(nn.Module):
     #: leading axis across stages.  Incompatible with attn_fn/seq_axis/
     #: MoE (those paths keep per-layer modules).
     scan_blocks: bool = False
+    #: autoregressive decode mode for serving (``models.generate``):
+    #: every attention layer keeps a ``max_len``-slot KV cache in the
+    #: ``"cache"`` variable collection and calls append to it, so the
+    #: prompt is processed once and each new token costs one T=1 step.
+    #: Apply with ``mutable=["cache"]`` and thread the returned cache.
+    #: Returns logits for the LAST input position only ([B, 1, V]) —
+    #: the one generation consumes; full-vocab f32 logits over a whole
+    #: prompt would dominate prefill activations for nothing.  Same
+    #: parameters as the training-mode model (``decode`` changes
+    #: execution, not the param tree).  Incompatible with seq_axis /
+    #: blockwise_attn / flash_attn / attn_fn / scan_blocks (decode
+    #: attention is one row against the cache — nothing to block).
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -213,6 +274,29 @@ class TransformerLM(nn.Module):
         tokens = tokens.astype(jnp.int32)
         t = tokens.shape[1]
         attn_fn = self.attn_fn
+        cache_len = 0
+        if self.decode:
+            if (self.seq_axis is not None or self.blockwise_attn
+                    or self.flash_attn or self.attn_fn is not None
+                    or self.scan_blocks):
+                raise ValueError(
+                    "decode=True is the KV-cache serving path: "
+                    "attention is one query row against the cache, so "
+                    "seq_axis/blockwise_attn/flash_attn/attn_fn/"
+                    "scan_blocks do not apply")
+            if self.num_experts > 0:
+                raise ValueError(
+                    "decode=True cannot serve MoE models: capacity-"
+                    "bucketed routing over a short decode step "
+                    "diverges from the full-forward routing the model "
+                    "trained with (different tokens overflow and "
+                    "drop) — serve MoE via the dense full-forward "
+                    "path (predictors) instead")
+            if t > self.max_len:
+                raise ValueError(
+                    f"decode chunk length {t} exceeds the cache size "
+                    f"max_len={self.max_len}")
+            cache_len = self.max_len
         if self.blockwise_attn and self.flash_attn:
             raise ValueError(
                 "blockwise_attn and flash_attn are mutually exclusive "
@@ -234,6 +318,12 @@ class TransformerLM(nn.Module):
             if attn_fn is None:
                 attn_fn = ring_attn_fn(self.seq_axis,
                                        q_chunk=self.attn_q_chunk)
+        elif self.decode:
+            t_global = t  # chunk length; prefix bound checked above
+            pos_var = self.variable("cache", "pos_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+            positions = (pos_var.value + jnp.arange(t))[None, :]
+            pos_var.value = pos_var.value + t
         else:
             t_global = t
             positions = jnp.arange(t)[None, :]
@@ -277,7 +367,14 @@ class TransformerLM(nn.Module):
                 x = Block(self.num_heads, self.mlp_ratio, dtype,
                           attn_fn, self.num_experts,
                           self.expert_capacity_factor,
-                          self.expert_top_k)(x)
+                          self.expert_top_k,
+                          cache_len=cache_len)(x)
+        if self.decode:
+            # serving returns next-token logits only: the f32
+            # full-vocab lm_head over every prompt position would be
+            # the prefill's dominant activation for nothing (only the
+            # last row seeds generation)
+            x = x[:, -1:]
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)

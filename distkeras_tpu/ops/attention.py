@@ -325,10 +325,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``parallel.ring_attention.blockwise_attention``; differentiable via
     hand-written backward kernels (first-order only).  ``block_q``/
     ``block_k`` default to the measured v5e optimum (512/1024) clamped
-    to the largest divisor of T, so any sequence length works; explicit
-    values are strict — they must divide T.  ``interpret`` defaults to
-    auto: the Pallas interpreter off-TPU so tests run anywhere,
-    compiled Mosaic on TPU.
+    to the largest divisor of T, so any sequence length works; an
+    explicit value is first clamped down to T (a block cannot exceed
+    the sequence) and must then divide T — anything else raises.
+    ``interpret`` defaults to auto: the Pallas interpreter off-TPU so
+    tests run anywhere, compiled Mosaic on TPU.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"

@@ -1,0 +1,107 @@
+"""Serving throughput for the LM family: KV-cache autoregressive
+decode (PERF.md §18).
+
+Measures the two numbers that characterize the serving path on one
+chip for a GPT-2-small-shaped ``TransformerLM``:
+
+- **prefill**: one forward over the prompt that fills every layer's
+  KV cache (compute-bound, ~the training forward);
+- **decode**: per-token latency of the T=1 cached step inside
+  ``lax.scan`` (bandwidth-bound: every weight is read per token), and
+  the resulting tokens/s at the given batch.
+
+Usage:  PYTHONPATH=/root/repo python scripts/perf_decode.py
+        [--layers 12 --d-model 768 --prompt 512 --new 128 --batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.profiling import host_sync, peak_flops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--new-lo", type=int, default=32)
+    ap.add_argument("--new-hi", type=int, default=160)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from distkeras_tpu.models import ModelSpec, generate, model_config
+
+    spec = model_config(
+        "transformer_lm", (args.max_len,), input_dtype="int32",
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        max_len=args.max_len, dtype=args.dtype)
+    model = ModelSpec.from_config(spec).build()
+    tokens = jnp.zeros((args.batch, args.max_len), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens[:, :8])
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    prompt = jax.random.randint(jax.random.key(1),
+                                (args.batch, args.prompt), 0,
+                                args.vocab)
+
+    # Per-token decode cost by DIFFERENCING two generation lengths:
+    # t(new_hi) - t(new_lo) cancels the prompt prefill AND the
+    # tunnel's per-dispatch round-trip (~140 ms on this rig — it
+    # swamps any absolute latency number, so no prefill/total latency
+    # is reported; only the differenced per-token cost is meaningful
+    # through the tunnel).  host_sync, not block_until_ready: the
+    # tunneled platform can return from block_until_ready before
+    # execution finishes (see profiling.host_sync).
+    def timed(n_new):
+        f = jax.jit(lambda v, p: generate(model, v, p,
+                                          max_new_tokens=n_new))
+        host_sync(f(variables, prompt))
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            host_sync(f(variables, prompt))
+        return (time.perf_counter() - t0) / args.reps
+
+    t_lo = timed(args.new_lo)
+    t_hi = timed(args.new_hi)
+    per_tok = (t_hi - t_lo) / (args.new_hi - args.new_lo)
+    # decode is bandwidth-bound: each token reads every parameter once
+    # (f32 param storage; compute casts to the model dtype)
+    hbm_gbs = n_params * 4 / per_tok / 1e9
+    peak, known = peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "model": f"lm L{args.layers} d{args.d_model} "
+                 f"prompt{args.prompt} new{args.new_lo}->"
+                 f"{args.new_hi} b{args.batch}",
+        "params_m": round(n_params / 1e6, 1),
+        "per_token_ms": round(per_tok * 1e3, 3),
+        "decode_tokens_per_sec": round(args.batch / per_tok, 1),
+        "weight_read_gb_per_sec": round(hbm_gbs, 1),
+        "mfu_decode": (round(2.0 * n_params * args.batch / per_tok
+                             / peak, 4) if known else None),
+        "t_lo_ms": round(t_lo * 1e3, 2),
+        "t_hi_ms": round(t_hi * 1e3, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
