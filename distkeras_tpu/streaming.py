@@ -89,3 +89,131 @@ class StreamingPredictor(ModelPredictor):
         if isinstance(rows, Dataset):
             return self.predict(rows)
         return self.predict_stream(rows)
+
+
+class StreamingGenerator:
+    """Micro-batched autoregressive LM serving over ``models.generate``.
+
+    ``generate_stream(rows)`` consumes an iterable of row dicts whose
+    ``prompt_col`` holds token ids and yields the same rows with a
+    ``output_col`` array of ``max_new_tokens`` generated ids appended,
+    in input order.  The TPU serving concerns mirror
+    ``StreamingPredictor`` — fixed compiled shapes — but a prompt
+    stream is ragged on TWO axes, so rows buffer into per-prompt-length
+    BUCKETS: a bucket flushes on its own when it fills to
+    ``batch_size`` (full device batches, no padding waste from mixed
+    lengths), and only end-of-stream/latency flushes pad — with whole
+    dummy ROWS (repeats of the bucket's last row), never pad tokens
+    inside a prompt, which would enter the KV cache and pollute real
+    rows' attention.  One ``jax.jit`` wrapper serves every bucket;
+    XLA's shape-keyed cache compiles each distinct prompt length once.
+    Results are re-ordered to input order before yielding.
+
+    ``flush_every`` bounds latency per ROW: once the oldest buffered
+    row has waited through that many consumed rows, ALL partial
+    buckets flush (padded) — a minority prompt length cannot be
+    starved by a majority length that keeps filling its own bucket.
+    Sampling (``temperature > 0``) keys each flush from ``seed`` and a
+    per-stream flush counter, so replaying a stream reproduces its
+    generations exactly — including on a reused instance (the counter
+    resets per ``generate_stream`` call; the compile cache persists).
+
+    A prompt that cannot fit (``len + max_new_tokens > max_len``)
+    raises at CONSUME time, naming the row — not later inside a jitted
+    flush where already-buffered neighbors would be lost with it.
+    """
+
+    def __init__(self, model, variables: Mapping, *,
+                 max_new_tokens: int, batch_size: int = 8,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 seed: int = 0, prompt_col: str = "prompt",
+                 output_col: str = "generated",
+                 flush_every: int | None = None):
+        import jax
+
+        from distkeras_tpu.models.generate import _decode_model, generate
+
+        # validate + normalize once (decode spelling is idempotent
+        # through generate's own _decode_model)
+        model = _decode_model(model)
+        self.max_len = model.max_len
+        # fail at construction, not inside the first jitted flush
+        # (where already-buffered rows would be lost with the error)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        if top_k is not None and not 1 <= top_k <= model.vocab_size:
+            raise ValueError(
+                f"top_k={top_k} out of range [1, {model.vocab_size}]")
+        self.variables = dict(variables)
+        self.max_new_tokens = int(max_new_tokens)
+        self.batch_size = int(batch_size)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.seed = int(seed)
+        self.prompt_col = prompt_col
+        self.output_col = output_col
+        self.flush_every = flush_every
+        n_new, temp, top = self.max_new_tokens, self.temperature, top_k
+        self._generate = jax.jit(
+            lambda v, p, rng: generate(model, v, p,
+                                       max_new_tokens=n_new,
+                                       temperature=temp, top_k=top,
+                                       rng=rng))
+
+    def _run_bucket(self, items: list, n_flush: int) -> dict:
+        """Generate for one same-length bucket; -> {row_index: out}."""
+        import jax
+
+        prompts = np.stack([np.asarray(r[self.prompt_col], np.int32)
+                            for _, r in items])
+        t_p = prompts.shape[1]
+        n = len(prompts)
+        if n < self.batch_size:  # dummy-ROW padding (tail flush only)
+            pad = np.repeat(prompts[-1:], self.batch_size - n, axis=0)
+            prompts = np.concatenate([prompts, pad], axis=0)
+        rng = jax.random.fold_in(jax.random.key(self.seed), n_flush)
+        full = np.asarray(self._generate(self.variables,
+                                         jnp.asarray(prompts), rng))
+        return {i: {**row, self.output_col: full[j, t_p:]}
+                for j, (i, row) in enumerate(items)}
+
+    def generate_stream(self, rows: Iterable[Mapping[str, Any]]
+                        ) -> Iterator[Mapping[str, Any]]:
+        buckets: dict[int, list] = {}      # prompt_len -> [(i, row)]
+        done: dict[int, Mapping] = {}      # row_index -> result
+        next_emit = 0
+        n_flush = 0   # per-stream: replay-reproducible sampling keys
+
+        def flush(t_p):
+            nonlocal n_flush
+            n_flush += 1
+            done.update(self._run_bucket(buckets.pop(t_p), n_flush))
+
+        for i, row in enumerate(rows):
+            t_p = len(np.asarray(row[self.prompt_col]))
+            if t_p < 1 or t_p + self.max_new_tokens > self.max_len:
+                raise ValueError(
+                    f"stream row {i}: prompt length {t_p} + "
+                    f"max_new_tokens {self.max_new_tokens} does not "
+                    f"fit max_len={self.max_len}")
+            buckets.setdefault(t_p, []).append((i, row))
+            if len(buckets[t_p]) >= self.batch_size:
+                flush(t_p)
+            # latency bound on the OLDEST buffered row (a full-bucket
+            # flush of a majority length must not starve the rest)
+            if (self.flush_every is not None and buckets
+                    and i - min(b[0][0] for b in buckets.values()) + 1
+                    >= self.flush_every):
+                for t in sorted(buckets):
+                    flush(t)
+            while next_emit in done:       # restore input order
+                yield done.pop(next_emit)
+                next_emit += 1
+        for t in sorted(buckets):
+            flush(t)
+        while next_emit in done:
+            yield done.pop(next_emit)
+            next_emit += 1
+
+    __call__ = generate_stream
