@@ -29,7 +29,10 @@ from distkeras_tpu.trainers import (  # noqa: F401
     Trainer,
 )
 from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
-from distkeras_tpu.streaming import StreamingPredictor  # noqa: F401
+from distkeras_tpu.streaming import (  # noqa: F401
+    StreamingGenerator,
+    StreamingPredictor,
+)
 from distkeras_tpu.evaluators import (  # noqa: F401
     AccuracyEvaluator,
     BinaryClassificationEvaluator,

@@ -191,7 +191,12 @@ class StreamingGenerator:
             done.update(self._run_bucket(buckets.pop(t_p), n_flush))
 
         for i, row in enumerate(rows):
-            t_p = len(np.asarray(row[self.prompt_col]))
+            prompt = np.asarray(row[self.prompt_col])
+            if prompt.ndim != 1:
+                raise ValueError(
+                    f"stream row {i}: prompt must be a 1-D token-id "
+                    f"array; got shape {prompt.shape}")
+            t_p = len(prompt)
             if t_p < 1 or t_p + self.max_new_tokens > self.max_len:
                 raise ValueError(
                     f"stream row {i}: prompt length {t_p} + "
