@@ -11,6 +11,7 @@ from distkeras_tpu.ops.losses import LOSSES, resolve_loss  # noqa: F401
 from distkeras_tpu.ops.metrics import (  # noqa: F401
     accuracy,
     binary_accuracy,
+    perplexity,
     top_k_accuracy,
 )
 

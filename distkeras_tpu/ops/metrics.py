@@ -31,6 +31,20 @@ def top_k_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
     return jnp.mean(hit.astype(jnp.float32))
 
 
+def perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """exp(mean next-token cross-entropy) — the LM eval metric.
+
+    ``logits``: ``[..., V]``; ``labels``: integer ids matching the
+    leading shape.  Uniform logits give exactly ``V``; a perfect model
+    gives 1.  Exponentiates the SAME cross-entropy the trainers
+    minimize (``ops.losses.categorical_crossentropy``), so eval ppl
+    and training loss can never silently diverge.
+    """
+    from distkeras_tpu.ops.losses import categorical_crossentropy
+
+    return jnp.exp(categorical_crossentropy(logits, labels))
+
+
 def auc_roc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Area under the ROC curve via the Mann-Whitney U statistic
     (rank-based, tie-aware) — the ``pyspark.ml``

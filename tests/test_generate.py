@@ -136,8 +136,8 @@ def test_tensor_parallel_decode_matches_single_device():
     """TP serving needs no dedicated decode API: shard the params with
     the trainer-side TP rules and jit generate — GSPMD propagates the
     head shardings into the per-layer KV caches and the scan."""
-    if len(jax.devices()) < 4:
-        pytest.skip("needs 4 devices")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
     from distkeras_tpu import mesh as mesh_lib
     from distkeras_tpu.parallel import tensor_parallel as tp
 

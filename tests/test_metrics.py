@@ -347,3 +347,29 @@ def test_binary_evaluator_rejects_empty():
         BinaryClassificationEvaluator().evaluate(
             Dataset({"prediction": np.zeros((0,)),
                      "label": np.zeros((0,))}))
+
+
+def test_perplexity():
+    from distkeras_tpu.ops.metrics import perplexity
+
+    rng = np.random.default_rng(0)
+    # uniform logits -> exactly V
+    v = 13
+    logits = np.zeros((4, 6, v), np.float32)
+    labels = rng.integers(0, v, (4, 6))
+    np.testing.assert_allclose(float(perplexity(logits, labels)), v,
+                               rtol=1e-5)
+    # a (nearly) perfect model -> ppl ~ 1
+    sharp = np.full((4, 6, v), -30.0, np.float32)
+    for i in range(4):
+        for t in range(6):
+            sharp[i, t, labels[i, t]] = 30.0
+    assert float(perplexity(sharp, labels)) < 1.0001
+    # matches manual mean-CE exponential on random logits
+    logits = rng.normal(size=(3, 5, v)).astype(np.float32)
+    labels2 = rng.integers(0, v, (3, 5))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    nll = -np.log(p[np.arange(3)[:, None], np.arange(5)[None], labels2])
+    np.testing.assert_allclose(float(perplexity(logits, labels2)),
+                               np.exp(nll.mean()), rtol=1e-5)
