@@ -199,17 +199,25 @@ def _auto_block(t, target):
     return b
 
 
-def _blocks(t, block_q, block_k):
+def _blocks_pair(t, tk, block_q, block_k):
+    """(block_q, block_k) for q length ``t`` and k length ``tk``:
+    defaults auto-clamp to the largest divisor <= the measured
+    optimum; explicit values are clamped to the length and must then
+    divide it."""
     bq = _auto_block(t, _DEFAULT_BLOCK_Q) if block_q is None \
         else min(block_q, t)
-    bk = _auto_block(t, _DEFAULT_BLOCK_K) if block_k is None \
-        else min(block_k, t)
-    if t % bq or t % bk:
+    bk = _auto_block(tk, _DEFAULT_BLOCK_K) if block_k is None \
+        else min(block_k, tk)
+    if t % bq or tk % bk:
         raise ValueError(
-            f"sequence length {t} must be divisible by "
+            f"lengths ({t}, {tk}) must be divisible by "
             f"block_q={bq} and block_k={bk} (pass block_q/block_k="
             f"None to auto-pick divisors)")
     return bq, bk
+
+
+def _blocks(t, block_q, block_k):
+    return _blocks_pair(t, t, block_q, block_k)
 
 
 def _qblk(bq, d):
@@ -354,3 +362,266 @@ def flash_attn_fn(causal: bool = True, block_q: int | None = None,
     divisors of T."""
     return functools.partial(flash_attention, causal=causal,
                              block_q=block_q, block_k=block_k)
+
+
+# ---------------------------------------------------------------------
+# Ring-hop kernels: the same online-softmax kernels with (a) the
+# softmax state (m, l, acc) carried IN and OUT instead of finalized,
+# and (b) global position offsets for q and k supplied as scalars —
+# one call processes one ring hop's K/V block against the local q
+# block, so sequence parallelism (parallel.ring_attention) can run
+# the Pallas path per hop while the ring carries the state between
+# devices.  Offsets are SMEM scalar inputs because they are traced
+# values inside the ring's lax.scan (the hop source rotates).
+# ---------------------------------------------------------------------
+
+
+def _off_mask(qo, ko, i, j, bq, bk):
+    """[bq, bk] causal mask in GLOBAL positions (qo/ko are scalars)."""
+    rows = qo + i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ko + j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+def _hop_fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, m_in_ref,
+                    l_in_ref, acc_in_ref, m_ref, l_ref, acc_ref,
+                    m_scr, l_scr, acc_scr, *, scale, causal, n_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    qo, ko = qo_ref[0], ko_ref[0]
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = m_in_ref[0, 0]
+        l_scr[:] = l_in_ref[0, 0]
+        acc_scr[:] = acc_in_ref[0, 0]
+
+    # a block contributes unless causally dead in global positions
+    alive = jnp.logical_or(
+        not causal, ko + j * bk <= qo + i * bq + bq - 1)
+
+    @pl.when(alive)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            logits = jnp.where(_off_mask(qo, ko, i, j, bq, bk),
+                               logits, _NEG)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        m_ref[0, 0] = m_scr[:]
+        l_ref[0, 0] = l_scr[:]
+        acc_ref[0, 0] = acc_scr[:]
+
+
+def _hop_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, dsum_ref, dq_ref, dq_scr, *, scale,
+                   causal, n_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+    qo, ko = qo_ref[0], ko_ref[0]
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    alive = jnp.logical_or(
+        not causal, ko + j * bk <= qo + i * bq + bq - 1)
+
+    @pl.when(alive)
+    def _():
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        do = do_ref[0, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = _off_mask(qo, ko, i, j, bq, bk)
+            logits = jnp.where(mask, logits, _NEG)
+        p = jnp.exp(logits - lse_ref[0, 0])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dsum_ref[0, 0]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[:]
+
+
+def _hop_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, dsum_ref, dk_ref, dv_ref, dk_scr,
+                    dv_scr, *, scale, causal, n_q):
+    j, i = pl.program_id(2), pl.program_id(3)
+    bk, bq = k_ref.shape[2], q_ref.shape[2]
+    qo, ko = qo_ref[0], ko_ref[0]
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    alive = jnp.logical_or(
+        not causal, qo + i * bq + bq - 1 >= ko + j * bk)
+
+    @pl.when(alive)
+    def _():
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        do = do_ref[0, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = _off_mask(qo, ko, i, j, bq, bk)
+            logits = jnp.where(mask, logits, _NEG)
+        p = jnp.exp(logits - lse_ref[0, 0])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        pt = p.astype(do.dtype)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - dsum_ref[0, 0]) * scale).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[:]
+        dv_ref[0, 0] = dv_scr[:]
+
+
+def _struct(vma, shape):
+    """f32 ShapeDtypeStruct, tagged varying-over-``vma`` mesh axes
+    when given (required for pallas outputs under shard_map's
+    check_vma)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+    return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                vma=frozenset(vma))
+
+
+def _scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def flash_hop_fwd(q, k, v, m, l, acc, *, q_offset, k_offset,
+                  scale, causal=True, block_q=None, block_k=None,
+                  vma=None, interpret=None):
+    """One ring hop of flash attention, state carried.
+
+    All arrays are [B, H, T, D]-layout blocks local to this device:
+    ``q`` is the resident query block; ``k``/``v`` the visiting hop's
+    K/V block; ``m``/``l`` [B, H, T, 1] and ``acc`` [B, H, T, D] the
+    running online-softmax state (f32).  ``q_offset``/``k_offset`` are
+    the blocks' global time positions (traced scalars are fine).
+    Returns the updated ``(m, l, acc)``.  The caller finalizes with
+    ``out = acc / max(l, eps)`` and ``lse = m + log l`` after the last
+    hop.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bq, bk = _blocks_pair(t, tk, block_q, block_k)
+    n_q, n_k = t // bq, tk // bk
+    kernel = functools.partial(_hop_fwd_kernel, scale=scale,
+                               causal=causal, n_k=n_k)
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    out = functools.partial(_struct, vma)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),
+        in_specs=[_scalar_spec(), _scalar_spec(),
+                  _qblk(bq, d), _kblk(bk, d), _kblk(bk, d),
+                  _qblk(bq, 1), _qblk(bq, 1), _qblk(bq, d)],
+        out_specs=[_qblk(bq, 1), _qblk(bq, 1), _qblk(bq, d)],
+        out_shape=[out((b, h, t, 1)), out((b, h, t, 1)),
+                   out((b, h, t, d))],
+        scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=None if interpret else _params(),
+        interpret=interpret,
+    )(qo, ko, q, k, v, m, l, acc)
+
+
+def flash_hop_bwd(q, k, v, do, lse, dsum, *, q_offset, k_offset,
+                  scale, causal=True, block_q=None, block_k=None,
+                  vma=None, interpret=None):
+    """One ring hop of the flash backward: partial ``(dq, dk, dv)``
+    for this (local q)×(visiting k/v) pair, to be accumulated by the
+    caller (dq locally; dk/dv riding the ring with their block).
+    ``lse`` [B, H, T, 1] is the FINAL logsumexp; ``dsum`` [B, H, T, 1]
+    is rowsum(dO·O)."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    bq, bk = _blocks_pair(t, tk, block_q, block_k)
+    n_q, n_k = t // bq, tk // bk
+    qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    dq = pl.pallas_call(
+        functools.partial(_hop_dq_kernel, scale=scale, causal=causal,
+                          n_k=n_k),
+        grid=(b, h, n_q, n_k),
+        in_specs=[_scalar_spec(), _scalar_spec(),
+                  _qblk(bq, d), _kblk(bk, d), _kblk(bk, d),
+                  _qblk(bq, d), _qblk(bq, 1), _qblk(bq, 1)],
+        out_specs=[_qblk(bq, d)],
+        out_shape=[_struct(vma, (b, h, t, d))],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=None if interpret else _params(),
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse, dsum)[0]
+
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=pltpu.VMEM)
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    rspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, j, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_hop_dkv_kernel, scale=scale, causal=causal,
+                          n_q=n_q),
+        grid=(b, h, n_k, n_q),
+        in_specs=[_scalar_spec(), _scalar_spec(),
+                  qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=[kspec, kspec],
+        out_shape=[_struct(vma, (b, h, tk, d)),
+                   _struct(vma, (b, h, tk, d))],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=None if interpret else _params(),
+        interpret=interpret,
+    )(qo, ko, q, k, v, do, lse, dsum)
+    return dq, dk, dv
+
+
+

@@ -94,6 +94,47 @@ def _pos_chunks(me, t_local, n_c, qc):
     return (me * t_local + jnp.arange(t_local)).reshape(n_c, qc)
 
 
+def _forward_scan_flash(q, k, v, axis_name, scale, causal, block_q,
+                        block_k):
+    """Ring forward with the Pallas hop kernels (ops.attention.
+    flash_hop_fwd): the online-softmax state (m, l, acc) lives in
+    [B, H, T_local, ...] layout and is updated by ONE Mosaic kernel
+    per hop while the K/V blocks rotate; only the final hop's state is
+    normalized.  Same math as the XLA-composed scan up to reduction
+    order (unit-tested both ways)."""
+    from distkeras_tpu.ops.attention import flash_hop_fwd
+
+    b, t_local, h, d = q.shape
+    n, me, ring = _ring(axis_name)
+    me = jnp.int32(me)
+    qt = jnp.swapaxes(q, 1, 2)                      # [B, H, T, D]
+
+    vma = None if axis_name is None else frozenset({axis_name})
+
+    def body(carry, s):
+        k_blk, v_blk, m, l, acc = carry             # k/v in BHTD
+        src = (me + s) % n
+        m, l, acc = flash_hop_fwd(
+            qt, k_blk, v_blk, m, l, acc,
+            q_offset=me * t_local, k_offset=src * t_local,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, vma=vma)
+        if ring is not None:
+            k_blk = lax.ppermute(k_blk, axis_name, ring)
+            v_blk = lax.ppermute(v_blk, axis_name, ring)
+        return (k_blk, v_blk, m, l, acc), None
+
+    init = (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+            *_vary(axis_name, (
+                jnp.full((b, h, t_local, 1), _NEG, jnp.float32),
+                jnp.zeros((b, h, t_local, 1), jnp.float32),
+                jnp.zeros((b, h, t_local, d), jnp.float32))))
+    (_, _, m, l, acc), _ = lax.scan(body, init, jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = jnp.swapaxes(acc / l, 1, 2)               # [B, T, H, D]
+    return out, (m + jnp.log(l))[..., 0]            # lse [B, H, T]
+
+
 def _forward_scan(q, k, v, axis_name, scale, causal, q_chunk=None):
     """Online-softmax ring forward.  Returns ``(out32 [B,T,H,D],
     L [B,H,T])`` where ``L = m + log(l)`` is the per-row logsumexp the
@@ -159,16 +200,84 @@ def _forward_scan(q, k, v, axis_name, scale, causal, q_chunk=None):
     return out, m + jnp.log(l)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_attention_f32(q, k, v, axis_name, scale, causal, q_chunk):
+def _bwd_flash(axis_name, scale, causal, block_q, block_k, residuals,
+               dout):
+    """Reverse ring with the Pallas hop kernels: per hop,
+    ``flash_hop_bwd`` emits this (q block)x(visiting k/v block) pair's
+    partial gradients; dq accumulates locally, dk/dv accumulate on
+    f32 carries that rotate WITH their k/v blocks (home after n hops).
+    """
+    from distkeras_tpu.ops.attention import flash_hop_bwd
+
+    q, k, v, out, lse = residuals
+    b, t_local, h, d = q.shape
+    n, me, ring = _ring(axis_name)
+    me = jnp.int32(me)
+    qt = jnp.swapaxes(q, 1, 2)
+    dot = jnp.swapaxes(dout, 1, 2).astype(q.dtype)
+    out_t = jnp.swapaxes(out, 1, 2).astype(jnp.float32)
+    dsum = jnp.sum(dot.astype(jnp.float32) * out_t, axis=-1,
+                   keepdims=True)                   # [B, H, T, 1]
+    lse4 = lse[..., None]                           # [B, H, T, 1]
+
+    vma = None if axis_name is None else frozenset({axis_name})
+
+    def body(carry, s):
+        k_blk, v_blk, dk, dv, dq = carry
+        src = (me + s) % n
+        dq_p, dk_p, dv_p = flash_hop_bwd(
+            qt, k_blk, v_blk, dot, lse4, dsum,
+            q_offset=me * t_local, k_offset=src * t_local,
+            scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, vma=vma)
+        dq = dq + dq_p
+        dk = dk + dk_p
+        dv = dv + dv_p
+        if ring is not None:
+            k_blk = lax.ppermute(k_blk, axis_name, ring)
+            v_blk = lax.ppermute(v_blk, axis_name, ring)
+            dk = lax.ppermute(dk, axis_name, ring)
+            dv = lax.ppermute(dv, axis_name, ring)
+        return (k_blk, v_blk, dk, dv, dq), None
+
+    zeros = jnp.zeros((b, h, t_local, d), jnp.float32)
+    init = (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+            *_vary(axis_name, (zeros, zeros, zeros)))
+    (_, _, dk, dv, dq), _ = lax.scan(body, init, jnp.arange(n))
+    return (jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+            jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+            jnp.swapaxes(dv, 1, 2).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8,
+                                                    9))
+def _ring_attention_f32(q, k, v, axis_name, scale, causal, q_chunk,
+                        impl, block_q, block_k):
+    if impl == "flash":
+        out, _ = _forward_scan_flash(q, k, v, axis_name, scale,
+                                     causal, block_q, block_k)
+        return out
     out, _ = _forward_scan(q, k, v, axis_name, scale, causal, q_chunk)
     return out
 
 
-def _fwd(q, k, v, axis_name, scale, causal, q_chunk):
-    out, lse = _forward_scan(q, k, v, axis_name, scale, causal,
-                             q_chunk)
+def _fwd(q, k, v, axis_name, scale, causal, q_chunk, impl, block_q,
+         block_k):
+    if impl == "flash":
+        out, lse = _forward_scan_flash(q, k, v, axis_name, scale,
+                                       causal, block_q, block_k)
+    else:
+        out, lse = _forward_scan(q, k, v, axis_name, scale, causal,
+                                 q_chunk)
     return out, (q, k, v, out, lse)
+
+
+def _bwd_dispatch(axis_name, scale, causal, q_chunk, impl, block_q,
+                  block_k, residuals, dout):
+    if impl == "flash":
+        return _bwd_flash(axis_name, scale, causal, block_q, block_k,
+                          residuals, dout)
+    return _bwd(axis_name, scale, causal, q_chunk, residuals, dout)
 
 
 def _bwd(axis_name, scale, causal, q_chunk, residuals, dout):
@@ -240,13 +349,16 @@ def _bwd(axis_name, scale, causal, q_chunk, residuals, dout):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
-_ring_attention_f32.defvjp(_fwd, _bwd)
+_ring_attention_f32.defvjp(_fwd, _bwd_dispatch)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, scale: float | None = None,
                    causal: bool = True,
-                   q_chunk: int | None = None) -> jax.Array:
+                   q_chunk: int | None = None,
+                   impl: str = "xla",
+                   block_q: int | None = None,
+                   block_k: int | None = None) -> jax.Array:
     """Exact (flash-accumulated) attention over a ring of devices.
 
     Args:
@@ -273,21 +385,37 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     accumulators riding the ring) with O(T_local) residual memory per
     device, honoring ``q_chunk``.  First-order only — higher-order
     autodiff through this op is not defined.
+
+    ``impl="flash"`` runs each hop's block computation as the Pallas
+    hop kernels (``ops.attention.flash_hop_fwd``/``flash_hop_bwd``;
+    ``block_q``/``block_k`` as in ``flash_attention``) instead of the
+    XLA-composed online softmax — the kernel path's VMEM-resident
+    accumulators and K/V streaming inside each hop, with the ring
+    still carrying the state between devices.  Math is identical up
+    to f32 reduction order; ``q_chunk`` applies to the XLA impl only.
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"impl must be 'xla' or 'flash'; got {impl!r}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out = _ring_attention_f32(
         q, k, v, axis_name, float(scale), bool(causal),
-        None if q_chunk is None else int(q_chunk))
+        None if q_chunk is None else int(q_chunk), impl,
+        None if block_q is None else int(block_q),
+        None if block_k is None else int(block_k))
     return out.astype(q.dtype)
 
 
 def ring_attn_fn(axis_name: str, causal: bool = True,
-                 q_chunk: int | None = None):
+                 q_chunk: int | None = None, impl: str = "xla",
+                 block_q: int | None = None,
+                 block_k: int | None = None):
     """An ``AttnFn`` (``TransformerLM.attn_fn`` signature) bound to a
     mesh axis: ``fn(q, k, v, *, scale)``."""
     return functools.partial(ring_attention, axis_name=axis_name,
-                             causal=causal, q_chunk=q_chunk)
+                             causal=causal, q_chunk=q_chunk,
+                             impl=impl, block_q=block_q,
+                             block_k=block_k)
 
 
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
