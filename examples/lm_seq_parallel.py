@@ -29,6 +29,11 @@ def main():
                         help="within-device q block length for ring "
                              "attention (bounds transient memory to "
                              "[q_chunk, T_local] per hop)")
+    parser.add_argument("--impl", choices=["xla", "flash"],
+                        default="xla",
+                        help="'flash': run each ring hop through the "
+                             "Pallas hop kernels (ops.attention; "
+                             "PERF.md §17 addendum 2)")
     add_data_option(parser)
     args = parse_args_and_setup(parser)
     from distkeras_tpu.profiling import profiler_trace
@@ -73,6 +78,14 @@ def _run(args):
     seq_model = ModelSpec.from_config(model_config(
         "transformer_lm", (args.seq_len,), input_dtype="int32",
         seq_axis="seq", attn_q_chunk=args.q_chunk, **lm_cfg)).build()
+    if args.impl == "flash":
+        from distkeras_tpu.parallel.ring_attention import ring_attn_fn
+
+        # --q-chunk maps to the kernel's q block size here (the XLA
+        # impl's q_chunk arg does not apply to the flash path)
+        seq_model = seq_model.clone(attn_fn=ring_attn_fn(
+            "seq", impl="flash", block_q=args.q_chunk,
+            block_k=args.q_chunk))
     dense_spec = ModelSpec.from_config(model_config(
         "transformer_lm", (args.seq_len,), input_dtype="int32",
         **lm_cfg))
@@ -90,7 +103,10 @@ def _run(args):
 
     sharded = jax.shard_map(
         shard_loss, mesh=mesh,
-        in_specs=(P(), P(None, "seq"), P(None, "seq")), out_specs=P())
+        in_specs=(P(), P(None, "seq"), P(None, "seq")), out_specs=P(),
+        # the Pallas interpreter requires check_vma=False (JAX
+        # limitation; see parallel.ring_attention docs)
+        check_vma=args.impl != "flash")
 
     @jax.jit
     def step(vs, opt_state, toks, tgt):
