@@ -74,7 +74,8 @@ def _select(logits, temperature, top_k, rng):
 
 def generate(model, variables: Mapping, prompt, *,
              max_new_tokens: int, temperature: float = 0.0,
-             top_k: int | None = None, rng=None):
+             top_k: int | None = None, rng=None,
+             eos_id: int | None = None, pad_id: int = 0):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -90,6 +91,12 @@ def generate(model, variables: Mapping, prompt, *,
       temperature: 0 = greedy argmax; > 0 = softmax sampling.
       top_k: optional sampling restriction to the k highest logits.
       rng: ``jax.random`` key, required when ``temperature > 0``.
+      eos_id: optional stop token: rows that emit it are finished —
+        the ``eos_id`` itself appears in the output and every later
+        position is ``pad_id``.  Shapes stay static (the scan always
+        runs ``max_new_tokens`` steps; finished rows just decode
+        ignored padding), which is the jit-compatible contract.
+      pad_id: filler for positions after ``eos_id`` (default 0).
 
     Returns:
       ``[B, T_prompt + max_new_tokens]`` int32 — prompt + generated.
@@ -116,6 +123,14 @@ def generate(model, variables: Mapping, prompt, *,
     if top_k is not None and not 1 <= top_k <= dec.vocab_size:
         raise ValueError(
             f"top_k={top_k} out of range [1, {dec.vocab_size}]")
+    if eos_id is not None and not 0 <= eos_id < dec.vocab_size:
+        raise ValueError(
+            f"eos_id={eos_id} outside vocab [0, {dec.vocab_size})")
+    if eos_id is not None and not 0 <= pad_id < dec.vocab_size:
+        # the pad token is fed back through the embedding on every
+        # post-eos step — an OOB id would be silently gather-clamped
+        raise ValueError(
+            f"pad_id={pad_id} outside vocab [0, {dec.vocab_size})")
     if rng is None:
         rng = jax.random.key(0)  # unused on the greedy path
     params = {"params": variables["params"]}
@@ -125,19 +140,24 @@ def generate(model, variables: Mapping, prompt, *,
     rng, sub = jax.random.split(rng)
     tok = _select(logits[:, -1].astype(jnp.float32), temperature,
                   top_k, sub)
+    done = (jnp.zeros(tok.shape, bool) if eos_id is None
+            else tok == eos_id)
 
     def step(carry, _):
-        cache, tok, rng = carry
+        cache, tok, rng, done = carry
         logits, state = dec.apply({**params, "cache": cache},
                                   tok[:, None], mutable=["cache"])
         rng, sub = jax.random.split(rng)
         nxt = _select(logits[:, -1].astype(jnp.float32), temperature,
                       top_k, sub)
-        return (state["cache"], nxt, rng), tok
+        if eos_id is not None:
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        return (state["cache"], nxt, rng, done), tok
 
     if max_new_tokens > 1:
-        (_, last, _), toks = lax.scan(
-            step, (state["cache"], tok, rng), None,
+        (_, last, _, _), toks = lax.scan(
+            step, (state["cache"], tok, rng, done), None,
             length=max_new_tokens - 1)
         new = jnp.concatenate([toks.T, last[:, None]], axis=1)
     else:

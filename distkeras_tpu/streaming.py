@@ -128,6 +128,7 @@ class StreamingGenerator:
                  temperature: float = 0.0, top_k: int | None = None,
                  seed: int = 0, prompt_col: str = "prompt",
                  output_col: str = "generated",
+                 eos_id: int | None = None, pad_id: int = 0,
                  flush_every: int | None = None):
         import jax
 
@@ -145,6 +146,12 @@ class StreamingGenerator:
         if top_k is not None and not 1 <= top_k <= model.vocab_size:
             raise ValueError(
                 f"top_k={top_k} out of range [1, {model.vocab_size}]")
+        if eos_id is not None and not (
+                0 <= eos_id < model.vocab_size
+                and 0 <= pad_id < model.vocab_size):
+            raise ValueError(
+                f"eos_id={eos_id}/pad_id={pad_id} outside vocab "
+                f"[0, {model.vocab_size})")
         self.variables = dict(variables)
         self.max_new_tokens = int(max_new_tokens)
         self.batch_size = int(batch_size)
@@ -159,7 +166,8 @@ class StreamingGenerator:
             lambda v, p, rng: generate(model, v, p,
                                        max_new_tokens=n_new,
                                        temperature=temp, top_k=top,
-                                       rng=rng))
+                                       rng=rng, eos_id=eos_id,
+                                       pad_id=pad_id))
 
     def _run_bucket(self, items: list, n_flush: int) -> dict:
         """Generate for one same-length bucket; -> {row_index: out}."""

@@ -170,3 +170,37 @@ def test_cache_overflow_poisons_with_nan():
                                   tok, mutable=["cache"])
         finite = np.isfinite(np.asarray(logits)).all()
         assert finite == (step < 2), (step, finite)
+
+
+def test_eos_stops_row_and_pads_rest():
+    """eos_id: the stop token appears, everything after is pad_id, and
+    rows stop independently; shapes stay static."""
+    spec, model, variables = _model()
+    prompt = jax.random.randint(jax.random.key(6), (2, 4), 0, 37)
+    base = np.asarray(generate(model, variables, prompt,
+                               max_new_tokens=8))
+    gen = base[:, 4:]
+    # pick an eos row 0 emits but row 1 never does, so the rows stop
+    # independently
+    candidates = [int(t) for t in gen[0] if t not in gen[1]]
+    assert candidates, "degenerate sample; adjust seed"
+    eos, pad = candidates[0], 36  # pad within vocab (checked)
+    out = np.asarray(generate(model, variables, prompt,
+                              max_new_tokens=8, eos_id=eos,
+                              pad_id=pad))
+    got = out[:, 4:]
+    # row 0: prefix matches greedy up to and incl. eos, then pad
+    stop = int(np.argwhere(gen[0] == eos)[0][0])
+    np.testing.assert_array_equal(got[0, :stop + 1],
+                                  gen[0, :stop + 1])
+    assert (got[0, stop + 1:] == pad).all()
+    # row 1 never emits eos and is untouched
+    np.testing.assert_array_equal(got[1], gen[1])
+    assert out.shape == base.shape  # static shapes
+
+    with pytest.raises(ValueError, match="eos_id"):
+        generate(model, variables, prompt, max_new_tokens=2,
+                 eos_id=99)
+    with pytest.raises(ValueError, match="pad_id"):
+        generate(model, variables, prompt, max_new_tokens=2,
+                 eos_id=eos, pad_id=99)
