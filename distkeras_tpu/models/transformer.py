@@ -252,6 +252,13 @@ class TransformerLM(nn.Module):
     #: leading axis across stages.  Incompatible with attn_fn/seq_axis/
     #: MoE (those paths keep per-layer modules).
     scan_blocks: bool = False
+    #: rematerialize each Block in the backward pass
+    #: (``jax.checkpoint``): activations inside a block are recomputed
+    #: from its input instead of stored, trading ~1 extra forward of
+    #: FLOPs for O(layers) less activation memory — the lever that
+    #: fits batches past the HBM envelope at long T (measured: b8 at
+    #: T=8192 OOMs by 2.4 GB without it; PERF.md §19).
+    remat_blocks: bool = False
     #: autoregressive decode mode for serving (``models.generate``):
     #: every attention layer keeps a ``max_len``-slot KV cache in the
     #: ``"cache"`` variable collection and calls append to it, so the
@@ -349,11 +356,11 @@ class TransformerLM(nn.Module):
         if self.scan_blocks:
             if (self.num_experts > 0 or self.attn_fn is not None
                     or self.seq_axis is not None or self.blockwise_attn
-                    or self.flash_attn):
+                    or self.flash_attn or self.remat_blocks):
                 raise ValueError(
                     "scan_blocks=True supports the dense-attention, "
                     "dense-FFN transformer only (MoE / custom attn / "
-                    "seq_axis keep per-layer modules)")
+                    "seq_axis / remat_blocks keep per-layer modules)")
             scanned = nn.scan(
                 _BlockScanBody,
                 variable_axes={"params": 0},
@@ -363,12 +370,18 @@ class TransformerLM(nn.Module):
                     name="blocks")
             x, _ = scanned(x, None)
         else:
-            for _ in range(self.num_layers):
-                x = Block(self.num_heads, self.mlp_ratio, dtype,
-                          attn_fn, self.num_experts,
-                          self.expert_capacity_factor,
-                          self.expert_top_k,
-                          cache_len=cache_len)(x)
+            block_cls = nn.remat(Block) if self.remat_blocks else Block
+            for i in range(self.num_layers):
+                # explicit names keep the param tree identical whether
+                # or not remat wraps the block (nn.remat's auto-name
+                # would be CheckpointBlock_i) — remat_blocks can be
+                # toggled on existing checkpoints
+                x = block_cls(self.num_heads, self.mlp_ratio, dtype,
+                              attn_fn, self.num_experts,
+                              self.expert_capacity_factor,
+                              self.expert_top_k,
+                              cache_len=cache_len,
+                              name=f"Block_{i}")(x)
         if self.decode:
             # serving returns next-token logits only: the f32
             # full-vocab lm_head over every prompt position would be

@@ -51,6 +51,10 @@ def main():
                     help="q block length for --attn blockwise; for "
                          "--attn flash the kernel's measured default "
                          "blocks (512/1024) are used")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize each block in the backward "
+                         "(jax.checkpoint): ~1 extra forward of FLOPs "
+                         "for O(layers) less activation memory")
     ap.add_argument("--experts", type=int, default=0,
                     help=">0 swaps every block's FFN for a top-1 "
                          "Switch MoE with this many experts (dense "
@@ -70,6 +74,7 @@ def main():
         d_model=args.d_model, num_heads=args.heads,
         max_len=args.seq_len, dtype="bfloat16",
         num_experts=args.experts,
+        remat_blocks=args.remat,
         blockwise_attn=args.attn == "blockwise",
         flash_attn=args.attn == "flash",
         attn_q_chunk=(args.q_chunk if args.attn == "blockwise"
