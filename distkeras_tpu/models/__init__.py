@@ -22,4 +22,7 @@ from distkeras_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from distkeras_tpu.models.lstm import BiLSTMClassifier  # noqa: F401
 from distkeras_tpu.models.widedeep import WideAndDeep  # noqa: F401
 from distkeras_tpu.models.transformer import TransformerLM  # noqa: F401
-from distkeras_tpu.models.generate import generate  # noqa: F401
+from distkeras_tpu.models.generate import (  # noqa: F401
+    beam_search,
+    generate,
+)

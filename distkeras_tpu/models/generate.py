@@ -163,3 +163,139 @@ def generate(model, variables: Mapping, prompt, *,
     else:
         new = tok[:, None]
     return jnp.concatenate([prompt, new], axis=1)
+
+
+def _gather_beams(tree, flat_idx):
+    """Reindex the batch-leading leaves of a cache/state pytree by
+    ``flat_idx`` (scalar leaves — cache_index/pos_index — are shared
+    across the batch and pass through)."""
+    return jax.tree_util.tree_map(
+        lambda x: x[flat_idx] if getattr(x, "ndim", 0) >= 1 else x,
+        tree)
+
+
+def beam_search(model, variables: Mapping, prompt, *,
+                max_new_tokens: int, num_beams: int = 4,
+                length_penalty: float = 0.0,
+                eos_id: int | None = None, pad_id: int = 0):
+    """Beam-search decoding: the highest-scoring continuation under
+    the model's own log-probabilities.
+
+    Same contract as ``generate`` (KV-cache decode, one compiled
+    program, static shapes) with a beam dimension folded into the
+    batch: the prompt is prefetched once per beam, every step scores
+    ``[B, W*V]`` candidates, keeps the top ``W``, and reorders the
+    KV caches and token histories by the surviving beams' parents.
+    ``num_beams=1`` reduces exactly to greedy ``generate``.
+
+    Args:
+      length_penalty: final scores are divided by
+        ``(length ** length_penalty)`` (0 = pure log-prob; > 0 favors
+        longer finished sequences, the usual knob when ``eos_id``
+        stops beams at different lengths).
+      eos_id / pad_id: as in ``generate`` — a beam that emits
+        ``eos_id`` is finished: its score freezes and it emits
+        ``pad_id`` from then on.
+
+    Returns:
+      ``(sequences, scores)``: ``[B, T_prompt + max_new_tokens]``
+      int32 and ``[B]`` f32 — the best beam per batch row and its
+      (length-penalized) cumulative log-probability.
+    """
+    dec = _decode_model(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError(
+            f"prompt must be [B, T_prompt>=1]; got {prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1; got {max_new_tokens}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1; got {num_beams}")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0; got {length_penalty}")
+    total = prompt.shape[1] + int(max_new_tokens)
+    if total > dec.max_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds max_len="
+            f"{dec.max_len}")
+    if eos_id is not None and not (0 <= eos_id < dec.vocab_size
+                                   and 0 <= pad_id < dec.vocab_size):
+        raise ValueError(
+            f"eos_id={eos_id}/pad_id={pad_id} outside vocab "
+            f"[0, {dec.vocab_size})")
+    params = {"params": variables["params"]}
+    b, w, v = prompt.shape[0], int(num_beams), dec.vocab_size
+    if w > v:
+        raise ValueError(f"num_beams={w} exceeds vocab_size={v}")
+    n_new = int(max_new_tokens)
+
+    # Prefill ONCE per batch row, then replicate the cache per beam
+    # (identical rows would just waste (W-1)/W of the prompt FLOPs).
+    logits, state = dec.apply(params, prompt, mutable=["cache"])
+    cache0 = jax.tree_util.tree_map(
+        lambda x: (jnp.repeat(x, w, axis=0)
+                   if getattr(x, "ndim", 0) >= 1 else x),
+        state["cache"])
+    state = {"cache": cache0}
+    logp = jax.nn.log_softmax(
+        logits[:, -1].astype(jnp.float32))               # [B, V]
+    # first pick: the top-W first tokens of each row's distribution
+    scores, tok = lax.top_k(logp, w)                     # [B, W]
+    tok = tok.astype(jnp.int32)
+    # parents are all beam 0; caches are identical — no gather needed
+    done = (tok == eos_id) if eos_id is not None \
+        else jnp.zeros((b, w), bool)
+    history = jnp.full((b, w, n_new), pad_id, jnp.int32)
+    history = history.at[:, :, 0].set(tok)
+    length = jnp.ones((b, w), jnp.int32)  # real tokens incl. eos
+
+    def step(carry, t):
+        cache, tok, scores, done, history, length = carry
+        logits, state = dec.apply({**params, "cache": cache},
+                                  tok.reshape(b * w, 1),
+                                  mutable=["cache"])
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32)).reshape(b, w, v)
+        if eos_id is not None:
+            # finished beams propose exactly one candidate: pad at
+            # unchanged score (0 logprob), everything else -inf
+            frozen = jnp.full((v,), -jnp.inf
+                              ).at[pad_id].set(0.0)
+            logp = jnp.where(done[..., None], frozen[None, None], logp)
+        cand = scores[..., None] + logp                  # [B, W, V]
+        scores, idx = lax.top_k(cand.reshape(b, w * v), w)
+        parent = idx // v                                # [B, W]
+        tok = (idx % v).astype(jnp.int32)
+        flat_parent = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
+        cache = _gather_beams(state["cache"], flat_parent)
+        history = jnp.take_along_axis(history, parent[..., None],
+                                      axis=1)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        length = jnp.take_along_axis(length, parent, axis=1)
+        if eos_id is not None:
+            tok = jnp.where(done, pad_id, tok)
+            length = jnp.where(done, length, t + 1)
+            done = done | (tok == eos_id)
+        else:
+            length = length + 1
+        history = history.at[:, :, t].set(tok)
+        return (cache, tok, scores, done, history, length), None
+
+    if n_new > 1:
+        (cache, tok, scores, done, history, length), _ = lax.scan(
+            step, (state["cache"], tok, scores, done, history,
+                   length),
+            jnp.arange(1, n_new))  # noqa: F841 (cache/tok unused)
+
+    if length_penalty > 0.0:
+        final = scores / jnp.maximum(length, 1) ** length_penalty
+    else:
+        final = scores
+    best = jnp.argmax(final, axis=1)                     # [B]
+    seq = jnp.take_along_axis(
+        history, best[:, None, None], axis=1)[:, 0]      # [B, n_new]
+    return (jnp.concatenate([prompt, seq], axis=1),
+            jnp.take_along_axis(final, best[:, None], axis=1)[:, 0])

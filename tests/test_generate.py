@@ -204,3 +204,76 @@ def test_eos_stops_row_and_pads_rest():
     with pytest.raises(ValueError, match="pad_id"):
         generate(model, variables, prompt, max_new_tokens=2,
                  eos_id=eos, pad_id=99)
+
+
+def test_beam_one_equals_greedy():
+    from distkeras_tpu.models.generate import beam_search
+
+    spec, model, variables = _model()
+    prompt = jax.random.randint(jax.random.key(8), (2, 4), 0, 37)
+    greedy = np.asarray(generate(model, variables, prompt,
+                                 max_new_tokens=6))
+    seq, scores = beam_search(model, variables, prompt,
+                              max_new_tokens=6, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(seq), greedy)
+    assert scores.shape == (2,) and np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_score_at_least_greedy():
+    """The width-4 beam's sequence log-prob must be >= greedy's (it
+    explores a superset of greedy's path), and its reported score must
+    equal the teacher-forced log-prob of its own sequence."""
+    from distkeras_tpu.models.generate import beam_search
+
+    spec, model, variables = _model()
+    prompt = jax.random.randint(jax.random.key(9), (2, 4), 0, 37)
+    n_new = 6
+
+    def seq_logprob(seq):
+        logits = model.apply(variables, seq).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        t0 = prompt.shape[1]
+        tot = 0.0
+        for t in range(t0, seq.shape[1]):
+            tot = tot + logp[jnp.arange(seq.shape[0]), t - 1,
+                             seq[:, t]]
+        return np.asarray(tot)
+
+    greedy = jnp.asarray(generate(model, variables, prompt,
+                                  max_new_tokens=n_new))
+    beam, scores = beam_search(model, variables, prompt,
+                               max_new_tokens=n_new, num_beams=4)
+    lp_greedy = seq_logprob(greedy)
+    lp_beam = seq_logprob(jnp.asarray(beam))
+    assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
+    np.testing.assert_allclose(np.asarray(scores), lp_beam, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_beam_eos_and_jit():
+    from distkeras_tpu.models.generate import beam_search
+
+    spec, model, variables = _model()
+    prompt = jax.random.randint(jax.random.key(10), (1, 3), 0, 37)
+    # eos = greedy's FIRST token (the highest first-step logprob): a
+    # beam finishing there freezes at the max single-step score, which
+    # strictly dominates any longer continuation at length_penalty=0 —
+    # so the winner MUST be the eos-terminated beam (no vacuous pass)
+    eos = int(np.asarray(generate(model, variables, prompt,
+                                  max_new_tokens=1))[0, 3])
+    seq, scores = beam_search(model, variables, prompt,
+                              max_new_tokens=6, num_beams=3,
+                              eos_id=eos, pad_id=36)
+    s = np.asarray(seq)[0, 3:]
+    assert s[0] == eos, s
+    assert (s[1:] == 36).all(), s
+    # jit wrapper produces identical output
+    jseq, jscores = jax.jit(lambda v, p: beam_search(
+        model, v, p, max_new_tokens=6, num_beams=3, eos_id=eos,
+        pad_id=36))(variables, prompt)
+    np.testing.assert_array_equal(np.asarray(jseq), np.asarray(seq))
+    np.testing.assert_allclose(np.asarray(jscores),
+                               np.asarray(scores), rtol=1e-6)
+    with pytest.raises(ValueError, match="length_penalty"):
+        beam_search(model, variables, prompt, max_new_tokens=2,
+                    num_beams=2, length_penalty=-1.0)
