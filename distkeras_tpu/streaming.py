@@ -126,13 +126,16 @@ class StreamingGenerator:
     def __init__(self, model, variables: Mapping, *,
                  max_new_tokens: int, batch_size: int = 8,
                  temperature: float = 0.0, top_k: int | None = None,
+                 num_beams: int = 1, length_penalty: float = 0.0,
                  seed: int = 0, prompt_col: str = "prompt",
                  output_col: str = "generated",
                  eos_id: int | None = None, pad_id: int = 0,
                  flush_every: int | None = None):
         import jax
 
-        from distkeras_tpu.models.generate import _decode_model, generate
+        from distkeras_tpu.models.generate import (_decode_model,
+                                                   beam_search,
+                                                   generate)
 
         # validate + normalize once (decode spelling is idempotent
         # through generate's own _decode_model)
@@ -161,13 +164,38 @@ class StreamingGenerator:
         self.prompt_col = prompt_col
         self.output_col = output_col
         self.flush_every = flush_every
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1; got {num_beams}")
+        if num_beams > model.vocab_size:
+            raise ValueError(
+                f"num_beams={num_beams} exceeds vocab_size="
+                f"{model.vocab_size}")
+        if length_penalty < 0:
+            raise ValueError(
+                f"length_penalty must be >= 0; got {length_penalty}")
+        if num_beams > 1 and (temperature > 0.0 or top_k is not None):
+            raise ValueError(
+                "num_beams > 1 is deterministic beam decoding; it "
+                "does not compose with temperature/top_k sampling")
         n_new, temp, top = self.max_new_tokens, self.temperature, top_k
-        self._generate = jax.jit(
-            lambda v, p, rng: generate(model, v, p,
-                                       max_new_tokens=n_new,
-                                       temperature=temp, top_k=top,
-                                       rng=rng, eos_id=eos_id,
-                                       pad_id=pad_id))
+        if num_beams > 1:
+            # rng is accepted (and ignored) so both strategies share
+            # one call signature; a "{output_col}_score" key is added
+            self._generate = jax.jit(
+                lambda v, p, rng: beam_search(
+                    model, v, p, max_new_tokens=n_new,
+                    num_beams=num_beams,
+                    length_penalty=length_penalty,
+                    eos_id=eos_id, pad_id=pad_id))
+        else:
+            self._generate = jax.jit(
+                lambda v, p, rng: generate(model, v, p,
+                                           max_new_tokens=n_new,
+                                           temperature=temp,
+                                           top_k=top, rng=rng,
+                                           eos_id=eos_id,
+                                           pad_id=pad_id))
+        self.num_beams = int(num_beams)
 
     def _run_bucket(self, items: list, n_flush: int) -> dict:
         """Generate for one same-length bucket; -> {row_index: out}."""
@@ -181,8 +209,13 @@ class StreamingGenerator:
             pad = np.repeat(prompts[-1:], self.batch_size - n, axis=0)
             prompts = np.concatenate([prompts, pad], axis=0)
         rng = jax.random.fold_in(jax.random.key(self.seed), n_flush)
-        full = np.asarray(self._generate(self.variables,
-                                         jnp.asarray(prompts), rng))
+        out = self._generate(self.variables, jnp.asarray(prompts), rng)
+        if self.num_beams > 1:
+            seqs, scores = (np.asarray(out[0]), np.asarray(out[1]))
+            return {i: {**row, self.output_col: seqs[j, t_p:],
+                        f"{self.output_col}_score": float(scores[j])}
+                    for j, (i, row) in enumerate(items)}
+        full = np.asarray(out)
         return {i: {**row, self.output_col: full[j, t_p:]}
                 for j, (i, row) in enumerate(items)}
 

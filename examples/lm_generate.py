@@ -87,11 +87,36 @@ def _run(args):
         gap = step.max(-1) - step[np.arange(len(gen)), gen[:, i]]
         assert (gap <= 0.05).all(), (i, gap)
 
+    # beam decoding: report both sequences' teacher-forced log-probs
+    # (beam typically scores higher; the guarantee is not strict once
+    # greedy's prefix can be pruned mid-search, so this reports
+    # rather than asserts)
+    from distkeras_tpu.models import beam_search
+
+    beam, beam_scores = beam_search(model, variables, prompt,
+                                    max_new_tokens=args.new_tokens,
+                                    num_beams=4)
+
+    def seq_logprob(seq):
+        lg = np.asarray(model.apply(variables, seq)
+                        .astype(jnp.float32))
+        lp = np.asarray(jax.nn.log_softmax(lg, axis=-1))
+        t0 = args.prompt_len
+        return sum(lp[np.arange(len(seq)), i - 1, np.asarray(seq)[:, i]]
+                   for i in range(t0, seq.shape[1]))
+
     out = {"example": "lm_generate",
            "epoch_loss": [round(x, 4)
                           for x in trainer.history["epoch_loss"]],
            "prompt": prompt[0].tolist(),
            "greedy": np.asarray(greedy)[0, args.prompt_len:].tolist(),
+           "beam": np.asarray(beam)[0, args.prompt_len:].tolist(),
+           "beam_scores": [round(float(s), 3) for s in
+                           np.asarray(beam_scores)],
+           "greedy_logprob": [round(float(x), 3)
+                              for x in seq_logprob(greedy)],
+           "beam_logprob": [round(float(x), 3)
+                            for x in seq_logprob(jnp.asarray(beam))],
            "decode_teacher_forced": True}
     if args.temperature > 0:
         sampled = generate(model, variables, prompt,

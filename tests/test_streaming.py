@@ -280,3 +280,34 @@ def test_generator_flush_every_bounds_oldest_row():
     rows = _prompt_rows([5, 20, 5])  # 20 + 8 > max_len=24
     with pytest.raises(ValueError, match="row 1"):
         list(sg2(iter(rows)))
+
+
+def test_generator_beam_strategy():
+    """num_beams>1 streams beam-decoded rows (+ a score key) equal to
+    direct beam_search, with the same bucketing/order machinery."""
+    from distkeras_tpu.models.generate import beam_search
+    from distkeras_tpu.streaming import StreamingGenerator
+
+    variables = _lm_variables()
+    rows = _prompt_rows([5, 7, 5])
+    sg = StreamingGenerator(LM_CFG, variables, max_new_tokens=4,
+                            batch_size=2, num_beams=3)
+    out = list(sg(iter(rows)))
+    assert [r["id"] for r in out] == [0, 1, 2]
+    model = ModelSpec.from_config(LM_CFG).build()
+    for r in out:
+        t_p = len(r["prompt"])
+        want, score = beam_search(model, variables,
+                                  r["prompt"][None, :],
+                                  max_new_tokens=4, num_beams=3)
+        np.testing.assert_array_equal(r["generated"],
+                                      np.asarray(want)[0, t_p:])
+        np.testing.assert_allclose(r["generated_score"],
+                                   float(np.asarray(score)[0]),
+                                   rtol=1e-5)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="temperature"):
+        StreamingGenerator(LM_CFG, variables, max_new_tokens=2,
+                           num_beams=2, temperature=0.5)
