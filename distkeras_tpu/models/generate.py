@@ -14,7 +14,8 @@ parameters, ``"cache"`` variable collection), then each new token is a
 T=1 step inside a ``lax.scan``, so the whole generation compiles to a
 single XLA program with static shapes — no per-token Python dispatch,
 no retracing across steps.  Greedy (``temperature=0``), temperature,
-and top-k sampling.
+top-k, and top-p (nucleus) sampling; ``beam_search`` decodes the
+highest-scoring continuation over the same machinery.
 """
 
 from __future__ import annotations
@@ -59,7 +60,7 @@ def _decode_model(model) -> TransformerLM:
                        seq_axis=None)
 
 
-def _select(logits, temperature, top_k, rng):
+def _select(logits, temperature, top_k, top_p, rng):
     """Next-token choice from ``[B, V]`` logits (f32)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -69,13 +70,24 @@ def _select(logits, temperature, top_k, rng):
         # sort — this runs once per decode step
         kth = lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the threshold token included)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # mask tokens whose PRECEDING cumulative mass already >= top_p
+        cut = jnp.sum((cum - probs) < top_p, axis=-1,
+                      keepdims=True)                  # tokens kept
+        kth = jnp.take_along_axis(sorted_logits, cut - 1, axis=-1)
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(model, variables: Mapping, prompt, *,
              max_new_tokens: int, temperature: float = 0.0,
-             top_k: int | None = None, rng=None,
-             eos_id: int | None = None, pad_id: int = 0):
+             top_k: int | None = None, top_p: float | None = None,
+             rng=None, eos_id: int | None = None, pad_id: int = 0):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
@@ -90,6 +102,9 @@ def generate(model, variables: Mapping, prompt, *,
         cache and position table size).
       temperature: 0 = greedy argmax; > 0 = softmax sampling.
       top_k: optional sampling restriction to the k highest logits.
+      top_p: optional nucleus sampling — restrict to the smallest set
+        of tokens whose probability mass reaches ``top_p`` (0, 1];
+        composes with ``top_k`` (both filters apply).
       rng: ``jax.random`` key, required when ``temperature > 0``.
       eos_id: optional stop token: rows that emit it are finished —
         the ``eos_id`` itself appears in the output and every later
@@ -123,6 +138,8 @@ def generate(model, variables: Mapping, prompt, *,
     if top_k is not None and not 1 <= top_k <= dec.vocab_size:
         raise ValueError(
             f"top_k={top_k} out of range [1, {dec.vocab_size}]")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} out of range (0, 1]")
     if eos_id is not None and not 0 <= eos_id < dec.vocab_size:
         raise ValueError(
             f"eos_id={eos_id} outside vocab [0, {dec.vocab_size})")
@@ -139,7 +156,7 @@ def generate(model, variables: Mapping, prompt, *,
     logits, state = dec.apply(params, prompt, mutable=["cache"])
     rng, sub = jax.random.split(rng)
     tok = _select(logits[:, -1].astype(jnp.float32), temperature,
-                  top_k, sub)
+                  top_k, top_p, sub)
     done = (jnp.zeros(tok.shape, bool) if eos_id is None
             else tok == eos_id)
 
@@ -149,7 +166,7 @@ def generate(model, variables: Mapping, prompt, *,
                                   tok[:, None], mutable=["cache"])
         rng, sub = jax.random.split(rng)
         nxt = _select(logits[:, -1].astype(jnp.float32), temperature,
-                      top_k, sub)
+                      top_k, top_p, sub)
         if eos_id is not None:
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)

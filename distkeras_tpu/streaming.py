@@ -126,6 +126,7 @@ class StreamingGenerator:
     def __init__(self, model, variables: Mapping, *,
                  max_new_tokens: int, batch_size: int = 8,
                  temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None,
                  num_beams: int = 1, length_penalty: float = 0.0,
                  seed: int = 0, prompt_col: str = "prompt",
                  output_col: str = "generated",
@@ -149,6 +150,8 @@ class StreamingGenerator:
         if top_k is not None and not 1 <= top_k <= model.vocab_size:
             raise ValueError(
                 f"top_k={top_k} out of range [1, {model.vocab_size}]")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p={top_p} out of range (0, 1]")
         if eos_id is not None and not (
                 0 <= eos_id < model.vocab_size
                 and 0 <= pad_id < model.vocab_size):
@@ -160,6 +163,7 @@ class StreamingGenerator:
         self.batch_size = int(batch_size)
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
         self.seed = int(seed)
         self.prompt_col = prompt_col
         self.output_col = output_col
@@ -173,10 +177,12 @@ class StreamingGenerator:
         if length_penalty < 0:
             raise ValueError(
                 f"length_penalty must be >= 0; got {length_penalty}")
-        if num_beams > 1 and (temperature > 0.0 or top_k is not None):
+        if num_beams > 1 and (temperature > 0.0 or top_k is not None
+                              or top_p is not None):
             raise ValueError(
                 "num_beams > 1 is deterministic beam decoding; it "
-                "does not compose with temperature/top_k sampling")
+                "does not compose with temperature/top_k/top_p "
+                "sampling")
         n_new, temp, top = self.max_new_tokens, self.temperature, top_k
         if num_beams > 1:
             # rng is accepted (and ignored) so both strategies share
@@ -192,8 +198,8 @@ class StreamingGenerator:
                 lambda v, p, rng: generate(model, v, p,
                                            max_new_tokens=n_new,
                                            temperature=temp,
-                                           top_k=top, rng=rng,
-                                           eos_id=eos_id,
+                                           top_k=top, top_p=top_p,
+                                           rng=rng, eos_id=eos_id,
                                            pad_id=pad_id))
         self.num_beams = int(num_beams)
 

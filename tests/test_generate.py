@@ -277,3 +277,31 @@ def test_beam_eos_and_jit():
     with pytest.raises(ValueError, match="length_penalty"):
         beam_search(model, variables, prompt, max_new_tokens=2,
                     num_beams=2, length_penalty=-1.0)
+
+
+def test_top_p_nucleus_sampling():
+    """top_p restricts draws to the smallest prefix of the sorted
+    distribution reaching that mass; a tiny top_p reduces to greedy."""
+    spec, model, variables = _model()
+    prompt = jnp.zeros((4, 3), jnp.int32)
+    # top_p -> 0+ keeps only the argmax token: equals greedy for any rng
+    greedy = generate(model, variables, prompt, max_new_tokens=5)
+    tiny = generate(model, variables, prompt, max_new_tokens=5,
+                    temperature=1.0, top_p=1e-6,
+                    rng=jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(tiny), np.asarray(greedy))
+    # top_p=1.0 is unrestricted sampling: reproducible, in-vocab
+    kw = dict(max_new_tokens=5, temperature=1.0, top_p=1.0,
+              rng=jax.random.key(2))
+    a = generate(model, variables, prompt, **kw)
+    b = generate(model, variables, prompt, **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (np.asarray(a) < 37).all()
+    # composes with top_k; invalid values rejected
+    c = generate(model, variables, prompt, max_new_tokens=3,
+                 temperature=0.9, top_k=10, top_p=0.9,
+                 rng=jax.random.key(3))
+    assert c.shape == (4, 6)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, variables, prompt, max_new_tokens=2,
+                 temperature=1.0, top_p=1.5, rng=jax.random.key(0))
