@@ -53,15 +53,26 @@ def _decode_model(model) -> TransformerLM:
             "drop), so cached decode would silently differ from what "
             "the trained model predicts.  Serve via the dense "
             "full-forward path (predictors) instead.")
-    # flash/blockwise/ring are execution spellings of the SAME
-    # parameters — decode replaces them with cached attention.
-    return model.clone(decode=True, flash_attn=False,
-                       blockwise_attn=False, attn_fn=None,
-                       seq_axis=None)
+    # The attention spellings (attn="auto"/flash_attn/blockwise_attn)
+    # are KEPT: decode mode uses them as the prefill kernel, so a long
+    # prompt runs the same flash/blockwise path training uses instead
+    # of a dense O(T·max_len) read of the cache; each generated token
+    # is a cached T=1 step either way.  Custom attn_fn and ring
+    # (seq_axis) are cleared — their contracts are training-path
+    # shapes.  remat_blocks off: decode never runs a backward pass,
+    # so rematerializing every step is pure overhead (ADVICE r4).
+    return model.clone(decode=True, attn_fn=None, seq_axis=None,
+                       remat_blocks=False)
 
 
 def _select(logits, temperature, top_k, top_p, rng):
-    """Next-token choice from ``[B, V]`` logits (f32)."""
+    """Next-token choice from ``[B, V]`` logits (f32).
+
+    Tie behavior of the ``top_p`` filter: every token whose logit
+    equals the nucleus-threshold logit is kept, so exact ties can
+    admit slightly more than ``top_p`` probability mass (the common
+    implementation choice — the kept set is threshold-defined, not
+    count-defined)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -91,10 +102,15 @@ def generate(model, variables: Mapping, prompt, *,
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     Args:
-      model: a ``TransformerLM`` (any attention spelling — decode mode
-        replaces it with cached attention), its ``ModelSpec``, or a
-        model config dict.  Parameters are shared with training: pass
-        the trained ``variables`` unchanged.
+      model: a ``TransformerLM``, its ``ModelSpec``, or a model config
+        dict.  Parameters are shared with training: pass the trained
+        ``variables`` unchanged.  The model's attention spelling
+        (``attn``/``flash_attn``/``blockwise_attn``) selects the
+        PREFILL kernel for 128-aligned prompt lengths — a long prompt
+        runs the same flash/blockwise path training used; unaligned
+        prompts and every generated token use cached dense attention
+        (never an error).  Custom ``attn_fn`` and ``seq_axis`` are
+        training-path contracts and are cleared for serving.
       variables: ``{"params": ...}`` as returned by init/training.
       prompt: ``[B, T_prompt]`` int32 token ids (``T_prompt >= 1``).
       max_new_tokens: number of tokens to append; ``T_prompt +

@@ -6,19 +6,28 @@ attention op is pluggable: dense causal attention on a single device, or
 ring attention over a mesh axis (``distkeras_tpu.parallel.ring_attention``)
 when ``seq_axis`` is set and the caller shards the time dimension
 (``parallel.ring_attention.sequence_sharded_apply``).
+
+By default (``attn="auto"``) the device-local attention spelling is
+selected per shape from the measured recipe (PERF.md §17): Pallas flash
+kernels at T >= 2048 (on TPU), the scan-composed blockwise path at
+T=1024-class shapes, dense below — so an untuned model gets the fastest
+measured execution for its sequence length.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distkeras_tpu.models.core import register_model
 from distkeras_tpu.parallel.moe import expert_capacity, routing
 
 AttnFn = Callable[..., jnp.ndarray]
+
+_ATTN_CHOICES = ("auto", "dense", "blockwise", "flash")
 
 
 def dense_causal_attention(q, k, v, *, scale):
@@ -31,20 +40,49 @@ def dense_causal_attention(q, k, v, *, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _quantize_kv(x):
+    """Symmetric per-(batch, position, head) int8 quantization of a
+    K/V chunk: returns ``(int8 values, f32 scales [..., 1])``.  The
+    scale is the row's abs-max over head_dim / 127, so dequantization
+    (``int8 * scale``) is error-bounded by amax/254 per element."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.where(scale > 0.0, xf / jnp.maximum(scale, 1e-30), 0.0)
+    return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8), scale
+
+
 class SelfAttention(nn.Module):
     """``cache_len > 0`` switches on autoregressive decode mode: K/V
     projections of every token seen so far persist in a ``"cache"``
     variable collection (``cached_key``/``cached_value`` sized
-    ``[B, cache_len, H, D]`` plus an insertion ``cache_index``), and
+    ``[B, cache_len, KVH, D]`` plus an insertion ``cache_index``), and
     each call appends its T tokens and attends back over the whole
-    prefix.  No counterpart in the reference — it predates
-    autoregressive serving entirely (SURVEY.md §0: MLP/CNN-era
-    workloads; predictors are one batched forward)."""
+    prefix.  A multi-token call (prefill) with an ``attn_fn`` runs the
+    chunk through that kernel instead of the dense cache read — exact
+    iff the cache was empty (poisoned loud otherwise).  No counterpart
+    in the reference — it predates autoregressive serving entirely
+    (SURVEY.md §0: MLP/CNN-era workloads; predictors are one batched
+    forward).
+
+    ``num_kv_heads`` (GQA): K/V project to fewer heads than Q; groups
+    of ``num_heads/num_kv_heads`` query heads share a K/V head.  The
+    decode-time win is the KV cache — its size and per-token HBM read
+    shrink by the group factor (PERF.md §18: decode is cache+weight
+    bandwidth-bound).  Training-path attention repeats K/V up to the
+    full head count (the kernels expect matched heads).
+
+    ``kv_cache_dtype="int8"`` stores the cache quantized (symmetric
+    per-position-per-head scales in f32) — halving the bf16 cache's
+    HBM traffic — and dequantizes on read.
+    """
 
     num_heads: int
     dtype: jnp.dtype
     attn_fn: Optional[AttnFn] = None
     cache_len: int = 0
+    num_kv_heads: Optional[int] = None
+    kv_cache_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
@@ -56,45 +94,102 @@ class SelfAttention(nn.Module):
                 f"d_model={d_model} not divisible by "
                 f"num_heads={self.num_heads}")
         head_dim = d_model // self.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (self.num_heads, head_dim), dtype=self.dtype, name=name)
-        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        kvh = self.num_kv_heads or self.num_heads
+        if self.num_heads % kvh:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"num_kv_heads={kvh}")
+        group = self.num_heads // kvh
+        dense = lambda name, heads: nn.DenseGeneral(  # noqa: E731
+            (heads, head_dim), dtype=self.dtype, name=name)
+        q = dense("query", self.num_heads)(x)
+        k = dense("key", kvh)(x)
+        v = dense("value", kvh)(x)
+        scale = head_dim ** -0.5
         if self.cache_len > 0:
             b, t = x.shape[0], x.shape[1]
-            shape = (b, self.cache_len, self.num_heads, head_dim)
+            quant = self.kv_cache_dtype == "int8"
+            store = jnp.int8 if quant else k.dtype
+            shape = (b, self.cache_len, kvh, head_dim)
             ck = self.variable("cache", "cached_key", jnp.zeros, shape,
-                               k.dtype)
+                               store)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               shape, v.dtype)
+                               shape, store)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
-            ck.value = lax.dynamic_update_slice(ck.value, k,
+            if quant:
+                sshape = (b, self.cache_len, kvh, 1)
+                ks = self.variable("cache", "key_scale", jnp.zeros,
+                                   sshape, jnp.float32)
+                vs = self.variable("cache", "value_scale", jnp.zeros,
+                                   sshape, jnp.float32)
+                k_w, k_s = _quantize_kv(k)
+                v_w, v_s = _quantize_kv(v)
+                ks.value = lax.dynamic_update_slice(ks.value, k_s,
+                                                    (0, idx, 0, 0))
+                vs.value = lax.dynamic_update_slice(vs.value, v_s,
+                                                    (0, idx, 0, 0))
+            else:
+                k_w, v_w = k, v
+            ck.value = lax.dynamic_update_slice(ck.value, k_w,
                                                 (0, idx, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v,
+            cv.value = lax.dynamic_update_slice(cv.value, v_w,
                                                 (0, idx, 0, 0))
             ci.value = idx + t
-            # q rows sit at global positions idx..idx+t-1; causal mask
-            # over the full cache (future slots are zeros AND masked)
-            q_pos = idx + jnp.arange(t)
-            k_pos = jnp.arange(self.cache_len)
-            mask = k_pos[None, :] <= q_pos[:, None]         # [t, L]
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) \
-                * head_dim ** -0.5
-            logits = jnp.where(mask[None, None], logits, -1e30)
-            probs = nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(q.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
             # Overflow is a traced condition (cache_index is dynamic),
             # so it cannot raise; dynamic_update_slice would silently
             # CLAMP the write and corrupt the cache.  Poison the
             # output with NaN instead — loud under jit, and it
             # propagates to any downstream logit/metric.
             ok = idx + t <= self.cache_len
+            if t > 1 and self.attn_fn is not None:
+                # Prefill through the block-attention kernel: causal
+                # attention WITHIN the chunk, on the raw (pre-
+                # quantization) projections.  Exact iff the cache was
+                # empty (idx == 0) — which generate()'s prompt pass
+                # guarantees; a mid-stream multi-token chunk needs
+                # cross-chunk attention, so poison that loud too.
+                kf, vf = k, v
+                if group > 1:
+                    kf = jnp.repeat(kf, group, axis=2)
+                    vf = jnp.repeat(vf, group, axis=2)
+                out = self.attn_fn(q, kf, vf, scale=scale)
+                ok = jnp.logical_and(ok, idx == 0)
+            else:
+                if quant:
+                    keys = (ck.value.astype(jnp.float32)
+                            * ks.value).astype(q.dtype)
+                    vals = (cv.value.astype(jnp.float32)
+                            * vs.value).astype(q.dtype)
+                else:
+                    keys, vals = ck.value, cv.value
+                # q rows sit at global positions idx..idx+t-1; causal
+                # mask over the full cache (future slots are zeros AND
+                # masked).  The grouped einsum attends each query-head
+                # group to its shared K/V head without materializing a
+                # repeated cache.
+                q_pos = idx + jnp.arange(t)
+                k_pos = jnp.arange(self.cache_len)
+                mask = k_pos[None, :] <= q_pos[:, None]     # [t, L]
+                qg = q.reshape(b, t, kvh, group, head_dim)
+                logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) \
+                    * scale
+                logits = jnp.where(mask[None, None, None], logits,
+                                   -1e30)
+                probs = nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(q.dtype)
+                out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vals)
+                out = out.reshape(b, t, self.num_heads, head_dim)
             out = jnp.where(ok, out, jnp.nan)
         else:
             attn = self.attn_fn or dense_causal_attention
-            out = attn(q, k, v, scale=head_dim ** -0.5)
+            if group > 1:
+                # attention fns expect matched head counts; GQA's win
+                # is the serving-time cache, so training repeats K/V
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            out = attn(q, k, v, scale=scale)
         return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
                                name="out")(out)
 
@@ -165,13 +260,17 @@ class Block(nn.Module):
     expert_capacity_factor: float = 1.25
     expert_top_k: int = 1
     cache_len: int = 0  # >0 = autoregressive decode (KV cache)
+    num_kv_heads: Optional[int] = None
+    kv_cache_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn,
-                              cache_len=self.cache_len)(y)
+                              cache_len=self.cache_len,
+                              num_kv_heads=self.num_kv_heads,
+                              kv_cache_dtype=self.kv_cache_dtype)(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.num_experts > 0:
             y = MoEFFN(self.num_experts, self.mlp_ratio, self.dtype,
@@ -193,10 +292,12 @@ class _BlockScanBody(nn.Module):
     num_heads: int
     mlp_ratio: int
     dtype: Any = jnp.bfloat16
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, carry, _):
         return Block(self.num_heads, self.mlp_ratio, self.dtype,
+                     num_kv_heads=self.num_kv_heads,
                      name="layer")(carry), None
 
 
@@ -217,11 +318,24 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: str = "bfloat16"
-    attn_fn: Optional[AttnFn] = None  # None -> dense causal / ring
+    attn_fn: Optional[AttnFn] = None  # None -> auto / dense / ring
     seq_axis: Optional[str] = None
     # within-device q block length for ring/blockwise attention (None =
     # full block); see parallel.ring_attention.ring_attention(q_chunk=)
     attn_q_chunk: Optional[int] = None
+    #: device-local attention spelling.  The default ``"auto"`` applies
+    #: the measured per-shape recipe (PERF.md §17): ``"flash"`` at
+    #: T >= 2048 (on TPU, where the Mosaic kernels run; elsewhere the
+    #: blockwise path substitutes), ``"blockwise"`` at T=1024-class
+    #: shapes, ``"dense"`` below — the regime boundary tracks the
+    #: quadratic term's share of the step (§17 addendum), so small B·H
+    #: long-T shapes sit exactly where the measured rows put them.
+    #: T must be a multiple of 128 for the blocked spellings (else
+    #: auto falls back to dense).  Explicit values force one spelling;
+    #: the ``flash_attn``/``blockwise_attn`` booleans and ``attn_fn``
+    #: (strongest) override this field.  Under ``scan_blocks`` /
+    #: ``decode`` T=1 steps, auto resolves to dense.
+    attn: str = "auto"
     #: single-device flash-style attention (JSON-able spelling of
     #: attn_fn=blockwise_attn_fn(...)): online-softmax q-chunking, the
     #: [T, T] logits never materialize — the long-T device-local path
@@ -239,6 +353,18 @@ class TransformerLM(nn.Module):
     #: in the kernel's WORST regime, so it is deliberately not reused
     #: here.  To tune blocks, pass attn_fn=flash_attn_fn(block_q=...).
     flash_attn: bool = False
+    #: GQA (grouped-query attention): number of K/V heads; must divide
+    #: num_heads.  None = one K/V head per query head (MHA).  Shrinks
+    #: the decode-time KV cache — the dominant per-token HBM read at
+    #: batch (PERF.md §18) — by num_heads/num_kv_heads; training-path
+    #: kernels see K/V repeated to the full head count.
+    num_kv_heads: Optional[int] = None
+    #: storage dtype of the serving KV cache (decode=True only).
+    #: None = the activation dtype; "int8" = symmetric per-position-
+    #: per-head quantization (f32 scales) — halves the bf16 cache's
+    #: per-token HBM traffic at an error bounded by amax/254 per
+    #: element (tolerance-tested in tests/test_generate.py).
+    kv_cache_dtype: Optional[str] = None
     # >0 replaces every block's MLP with a mixture-of-experts FFN
     # (dense einsum form — shard the expert axes via the TP rules for
     # expert parallelism); the load-balance aux loss rides the
@@ -250,7 +376,8 @@ class TransformerLM(nn.Module):
     #: math per layer; different param-tree layout).  Required by the
     #: pipeline-parallel trainer path, which shards the layer stack's
     #: leading axis across stages.  Incompatible with attn_fn/seq_axis/
-    #: MoE (those paths keep per-layer modules).
+    #: MoE (those paths keep per-layer modules); attn="auto" resolves
+    #: to dense under scan.
     scan_blocks: bool = False
     #: rematerialize each Block in the backward pass
     #: (``jax.checkpoint``): activations inside a block are recomputed
@@ -268,10 +395,48 @@ class TransformerLM(nn.Module):
     #: the one generation consumes; full-vocab f32 logits over a whole
     #: prompt would dominate prefill activations for nothing.  Same
     #: parameters as the training-mode model (``decode`` changes
-    #: execution, not the param tree).  Incompatible with seq_axis /
-    #: blockwise_attn / flash_attn / attn_fn / scan_blocks (decode
-    #: attention is one row against the cache — nothing to block).
+    #: execution, not the param tree).  The attention spelling
+    #: (attn/flash_attn/blockwise_attn/attn_fn) selects the PREFILL
+    #: attention: a multi-token chunk at cache position 0 runs through
+    #: that kernel instead of a dense read of the whole cache (the
+    #: round-4 gap: a T=4096 prompt paid O(T·max_len) dense prefill
+    #: while training the same shape got the flash kernels).  T=1
+    #: steps always use the cached dense row.  Incompatible with
+    #: seq_axis / scan_blocks.
     decode: bool = False
+
+    def _local_attn_fn(self, t: int) -> Optional[AttnFn]:
+        """Resolve the device-local attention spelling for sequence
+        length ``t`` (None = dense).  Precedence: attn_fn > the
+        boolean spellings > ``attn`` (whose "auto" applies the
+        measured PERF.md §17 recipe)."""
+        if self.attn_fn is not None:
+            return self.attn_fn
+        spelling = self.attn
+        if self.flash_attn:
+            spelling = "flash"
+        elif self.blockwise_attn:
+            spelling = "blockwise"
+        if spelling == "auto":
+            # measured recipe: flash at T>=2048 (TPU), blockwise at
+            # T=1024-class, dense below; blocked spellings need
+            # 128-aligned T (Mosaic tiling / chunk divisibility)
+            if t < 1024 or t % 128:
+                return None
+            if t >= 2048 and jax.devices()[0].platform == "tpu":
+                spelling = "flash"
+            else:
+                spelling = "blockwise"
+        if spelling == "dense":
+            return None
+        if spelling == "flash":
+            from distkeras_tpu.ops.attention import flash_attn_fn
+
+            return flash_attn_fn()
+        from distkeras_tpu.parallel.ring_attention import \
+            blockwise_attn_fn
+
+        return blockwise_attn_fn(q_chunk=self.attn_q_chunk or 128)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -280,17 +445,22 @@ class TransformerLM(nn.Module):
         dtype = jnp.dtype(self.dtype)
         tokens = tokens.astype(jnp.int32)
         t = tokens.shape[1]
+        if self.attn not in _ATTN_CHOICES:
+            raise ValueError(
+                f"attn={self.attn!r} not one of {_ATTN_CHOICES}")
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} must be None "
+                "(activation dtype) or 'int8'")
         attn_fn = self.attn_fn
         cache_len = 0
         if self.decode:
-            if (self.seq_axis is not None or self.blockwise_attn
-                    or self.flash_attn or self.attn_fn is not None
-                    or self.scan_blocks):
+            if self.seq_axis is not None or self.scan_blocks:
                 raise ValueError(
                     "decode=True is the KV-cache serving path: "
-                    "attention is one query row against the cache, so "
-                    "seq_axis/blockwise_attn/flash_attn/attn_fn/"
-                    "scan_blocks do not apply")
+                    "seq_axis/scan_blocks do not apply (the attention "
+                    "spellings select the PREFILL kernel; generated "
+                    "tokens are cached T=1 steps)")
             if self.num_experts > 0:
                 raise ValueError(
                     "decode=True cannot serve MoE models: capacity-"
@@ -309,13 +479,14 @@ class TransformerLM(nn.Module):
                 "blockwise_attn and flash_attn are mutually exclusive "
                 "spellings of the device-local flash-style attention "
                 "path")
-        if self.seq_axis is not None and (self.blockwise_attn
-                                          or self.flash_attn):
+        if self.seq_axis is not None and (
+                self.blockwise_attn or self.flash_attn
+                or self.attn != "auto"):
             raise ValueError(
-                "blockwise_attn/flash_attn are device-local attention "
-                "paths; with seq_axis the attention is ring attention "
-                "over the mesh — use attn_q_chunk to bound its "
-                "within-device blocks instead")
+                "blockwise_attn/flash_attn/attn are device-local "
+                "attention spellings; with seq_axis the attention is "
+                "ring attention over the mesh — use attn_q_chunk to "
+                "bound its within-device blocks instead")
         if self.seq_axis is not None:
             from distkeras_tpu.parallel.ring_attention import ring_attn_fn
 
@@ -331,20 +502,24 @@ class TransformerLM(nn.Module):
                                     lambda: jnp.zeros((), jnp.int32))
             positions = (pos_var.value + jnp.arange(t))[None, :]
             pos_var.value = pos_var.value + t
+            # multi-token chunks (prefill) run the resolved kernel
+            # inside SelfAttention; T=1 steps use the cached row.
+            # Serving prompts have ARBITRARY lengths and the blocked
+            # kernels reject unaligned ones (q_chunk divisibility /
+            # Mosaic tiling), so every spelling falls back to the
+            # dense cache read off the 128-aligned grid — a slower
+            # prefill must never be a serving error.  A custom
+            # attn_fn is honored as given (the caller owns its
+            # shape contract; generate() clears it).
+            if t > 1 and (self.attn_fn is not None or t % 128 == 0):
+                attn_fn = self._local_attn_fn(t)
+            else:
+                attn_fn = None
         else:
             t_global = t
             positions = jnp.arange(t)[None, :]
-            if attn_fn is None and self.blockwise_attn:
-                from distkeras_tpu.parallel.ring_attention import \
-                    blockwise_attn_fn
-
-                attn_fn = blockwise_attn_fn(
-                    q_chunk=self.attn_q_chunk or 128)
-            elif attn_fn is None and self.flash_attn:
-                from distkeras_tpu.ops.attention import \
-                    flash_attn_fn
-
-                attn_fn = flash_attn_fn()
+            if not self.scan_blocks:
+                attn_fn = self._local_attn_fn(t)
         if t_global > self.max_len:
             raise ValueError(
                 f"sequence length {t_global} exceeds "
@@ -356,7 +531,8 @@ class TransformerLM(nn.Module):
         if self.scan_blocks:
             if (self.num_experts > 0 or self.attn_fn is not None
                     or self.seq_axis is not None or self.blockwise_attn
-                    or self.flash_attn or self.remat_blocks):
+                    or self.flash_attn or self.remat_blocks
+                    or self.attn not in ("auto", "dense")):
                 raise ValueError(
                     "scan_blocks=True supports the dense-attention, "
                     "dense-FFN transformer only (MoE / custom attn / "
@@ -367,6 +543,7 @@ class TransformerLM(nn.Module):
                 split_rngs={"params": True},
                 length=self.num_layers)(
                     self.num_heads, self.mlp_ratio, dtype,
+                    num_kv_heads=self.num_kv_heads,
                     name="blocks")
             x, _ = scanned(x, None)
         else:
@@ -381,6 +558,8 @@ class TransformerLM(nn.Module):
                               self.expert_capacity_factor,
                               self.expert_top_k,
                               cache_len=cache_len,
+                              num_kv_heads=self.num_kv_heads,
+                              kv_cache_dtype=self.kv_cache_dtype,
                               name=f"Block_{i}")(x)
         if self.decode:
             # serving returns next-token logits only: the f32

@@ -220,6 +220,23 @@ def _blocks(t, block_q, block_k):
     return _blocks_pair(t, t, block_q, block_k)
 
 
+def _check_mosaic_alignment(bq, bk, t, tk):
+    """Compiled Mosaic requires lane/sublane-aligned tiles; an
+    unaligned auto-picked block (e.g. prime or odd T, where the
+    largest divisor degrades toward 1) fails deep in the compiler
+    with an opaque tiling error.  Catch it here with an actionable
+    one.  The interpreter path accepts any block, so this only runs
+    when compiling (interpret=False)."""
+    if bq % 8 or bk % 8:
+        raise ValueError(
+            f"sequence lengths ({t}, {tk}) have no MXU-aligned "
+            f"divisor <= the block targets (picked block_q={bq}, "
+            f"block_k={bk}); compiled Mosaic needs blocks that are "
+            "multiples of 8 (ideally 128).  Pad the sequence to a "
+            "multiple of 128, or pass explicit aligned "
+            "block_q/block_k that divide it.")
+
+
 def _qblk(bq, d):
     """BlockSpec for a per-(b, h, i) q-shaped operand on [B, H, T, D]."""
     return pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
@@ -344,6 +361,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     bq, bk = _blocks(q.shape[1], block_q, block_k)
+    if not interpret:
+        _check_mosaic_alignment(bq, bk, q.shape[1], q.shape[1])
     # [B, T, H, D] -> [B, H, T, D]: one transpose each way per pass —
     # negligible (O(T)) next to attention's O(T^2), and it gives the
     # kernels their natural (rows = time, lanes = head_dim) layout.
@@ -548,6 +567,8 @@ def flash_hop_fwd(q, k, v, m, l, acc, *, q_offset, k_offset,
     b, h, t, d = q.shape
     tk = k.shape[2]
     bq, bk = _blocks_pair(t, tk, block_q, block_k)
+    if not interpret:
+        _check_mosaic_alignment(bq, bk, t, tk)
     n_q, n_k = t // bq, tk // bk
     kernel = functools.partial(_hop_fwd_kernel, scale=scale,
                                causal=causal, n_k=n_k)
@@ -584,6 +605,8 @@ def flash_hop_bwd(q, k, v, do, lse, dsum, *, q_offset, k_offset,
     b, h, t, d = q.shape
     tk = k.shape[2]
     bq, bk = _blocks_pair(t, tk, block_q, block_k)
+    if not interpret:
+        _check_mosaic_alignment(bq, bk, t, tk)
     n_q, n_k = t // bq, tk // bk
     qo = jnp.asarray(q_offset, jnp.int32).reshape(1)
     ko = jnp.asarray(k_offset, jnp.int32).reshape(1)

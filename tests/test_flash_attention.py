@@ -173,3 +173,19 @@ def test_flash_with_seq_axis_rejected_loudly():
     tokens = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(ValueError, match="ring attention"):
         model.init(jax.random.key(0), tokens)
+
+
+def test_unaligned_auto_block_raises_descriptive_error_when_compiled():
+    """ADVICE r4: for lengths with no MXU-friendly divisor the auto
+    block picker degrades toward unaligned blocks that compiled Mosaic
+    rejects with an opaque tiling error — the compiled path must catch
+    that up front with an actionable ValueError (the interpreter
+    accepts any block, so only interpret=False checks)."""
+    from distkeras_tpu.ops.attention import flash_attention
+
+    q = jnp.zeros((1, 257, 2, 8), jnp.float32)  # 257 prime -> bq=257
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, q, q, interpret=False)
+    # the interpreter still takes it (tests run anywhere)
+    out = flash_attention(q, q, q, interpret=True)
+    assert out.shape == q.shape

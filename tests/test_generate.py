@@ -305,3 +305,129 @@ def test_top_p_nucleus_sampling():
     with pytest.raises(ValueError, match="top_p"):
         generate(model, variables, prompt, max_new_tokens=2,
                  temperature=1.0, top_p=1.5, rng=jax.random.key(0))
+
+
+def test_flash_and_blockwise_prefill_match_full_forward():
+    """VERDICT r4 #3: decode's prompt pass runs through the resolved
+    attention kernel (flash/blockwise) instead of a dense read of the
+    whole cache — and must still reproduce full-forward logits.
+    Prefill kernels engage at 128-aligned prompt lengths."""
+    spec, model, variables = _model(max_len=192)
+    prompt = jax.random.randint(jax.random.key(11), (2, 128), 0, 37)
+    want = model.apply(variables, prompt)
+    for spelling in ({"flash_attn": True}, {"blockwise_attn": True},
+                     {"attn": "blockwise"}):
+        dec = model.clone(decode=True, **spelling)
+        got, state = dec.apply({"params": variables["params"]},
+                               prompt, mutable=["cache"])
+        np.testing.assert_allclose(np.asarray(got[:, 0]),
+                                   np.asarray(want[:, -1]),
+                                   rtol=2e-5, atol=2e-5)
+        # the cache is filled exactly as the dense prefill fills it,
+        # so subsequent T=1 steps continue correctly
+        tok = jnp.argmax(got[:, -1].astype(jnp.float32),
+                         axis=-1)[:, None].astype(jnp.int32)
+        nxt, _ = dec.apply(
+            {"params": variables["params"], "cache": state["cache"]},
+            tok, mutable=["cache"])
+        full = jnp.concatenate([prompt, tok], axis=1)
+        want2 = model.apply(variables, full)
+        np.testing.assert_allclose(np.asarray(nxt[:, 0]),
+                                   np.asarray(want2[:, -1]),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_prefill_mid_stream_chunk_poisons_with_nan():
+    """A multi-token chunk at cache position > 0 would need
+    cross-chunk attention the prefill kernel does not compute — it
+    must fail LOUD (NaN), never silently drop the prefix."""
+    spec, model, variables = _model(max_len=384)
+    dec = model.clone(decode=True, flash_attn=True)
+    params = {"params": variables["params"]}
+    logits, state = dec.apply(params, jnp.zeros((1, 128), jnp.int32),
+                              mutable=["cache"])
+    assert np.isfinite(np.asarray(logits)).all()
+    logits, _ = dec.apply({**params, "cache": state["cache"]},
+                          jnp.zeros((1, 128), jnp.int32),
+                          mutable=["cache"])
+    assert not np.isfinite(np.asarray(logits)).any()
+
+
+def test_unaligned_prompts_serve_via_dense_fallback():
+    """Serving prompts have arbitrary lengths; the blocked prefill
+    kernels only take 128-aligned chunks, so every other length must
+    fall back to the dense cache read — generate() must NEVER raise
+    over a prompt length (regression: round-5 review finding)."""
+    spec, model, variables = _model(max_len=256)
+    for spelling in ({"flash_attn": True}, {"blockwise_attn": True}):
+        m = model.clone(**spelling)
+        for t in (1, 7, 130, 200):
+            prompt = jax.random.randint(jax.random.key(t), (1, t),
+                                        0, 37)
+            want = generate(model, variables, prompt,
+                            max_new_tokens=3)
+            got = generate(m, variables, prompt, max_new_tokens=3)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+
+
+def test_gqa_generate_matches_naive_reforward_loop():
+    """num_kv_heads (GQA): the grouped decode path must agree token
+    for token with the training-mode forward of the SAME params."""
+    spec, model, variables = _model(num_kv_heads=1)
+    kernel = variables["params"]["Block_0"]["SelfAttention_0"]["key"][
+        "kernel"]
+    assert kernel.shape == (32, 1, 16)  # K/V project to 1 head
+    prompt = jax.random.randint(jax.random.key(12), (2, 5), 0, 37)
+    got = generate(model, variables, prompt, max_new_tokens=6)
+    seq = prompt
+    for _ in range(6):
+        logits = model.apply(variables, seq)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        seq = jnp.concatenate([seq, nxt[:, None].astype(jnp.int32)],
+                              axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
+
+
+def test_gqa_validates_head_divisibility():
+    spec, model, variables = _model()
+    bad = model.clone(num_kv_heads=3)  # 2 heads % 3 != 0
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        bad.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_int8_kv_cache_close_to_full_precision():
+    """kv_cache_dtype="int8": the cache stores int8 + f32 scales; the
+    prompt-pass logits stay within the quantization error bound of
+    the full-precision decode, and (for this well-conditioned tiny
+    model) greedy tokens are unchanged."""
+    spec, model, variables = _model()
+    prompt = jax.random.randint(jax.random.key(13), (2, 9), 0, 37)
+    want = model.apply(variables, prompt)
+    dec = model.clone(decode=True, kv_cache_dtype="int8")
+    got, state = dec.apply({"params": variables["params"]}, prompt,
+                           mutable=["cache"])
+    cache = state["cache"]["Block_0"]["SelfAttention_0"]
+    assert cache["cached_key"].dtype == jnp.int8
+    assert cache["key_scale"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got[:, 0]),
+                               np.asarray(want[:, -1]),
+                               rtol=0.05, atol=0.05)
+    base = generate(model, variables, prompt, max_new_tokens=5)
+    quant = generate(model.clone(kv_cache_dtype="int8"), variables,
+                     prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(quant))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        model.clone(kv_cache_dtype="fp4").init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_gqa_int8_compose_in_generate():
+    """GQA × int8 cache: both levers together still greedy-decode the
+    same tokens as the full-precision model on this tiny LM."""
+    spec, model, variables = _model(num_kv_heads=1)
+    prompt = jax.random.randint(jax.random.key(14), (2, 6), 0, 37)
+    base = generate(model, variables, prompt, max_new_tokens=5)
+    both = generate(model.clone(kv_cache_dtype="int8"), variables,
+                    prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(both))

@@ -172,3 +172,71 @@ def test_transformer_remat_blocks_is_exact():
                     jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+def test_attn_auto_resolution_follows_measured_recipe():
+    """attn="auto" (the default) applies PERF.md §17's measured
+    per-shape recipe: dense below T=1024 (and for unaligned T),
+    blockwise at T=1024-class shapes, flash at T>=2048 on TPU (the
+    blockwise path substitutes off-TPU, where the Mosaic kernels
+    would run interpreted)."""
+    import functools
+    from distkeras_tpu.models import TransformerLM
+
+    m = TransformerLM(max_len=65536)
+    assert m.attn == "auto"
+    assert m._local_attn_fn(256) is None          # dense below 1024
+    assert m._local_attn_fn(1000) is None         # unaligned -> dense
+    bw = m._local_attn_fn(1024)
+    assert isinstance(bw, functools.partial)
+    assert "blockwise" in bw.func.__name__
+    long = m._local_attn_fn(4096)                 # CPU: blockwise subs
+    on_tpu = jax.devices()[0].platform == "tpu"
+    want = "flash" if on_tpu else "blockwise"
+    assert want in long.func.__name__
+    # explicit spellings override auto
+    assert m.clone(attn="dense")._local_attn_fn(4096) is None
+    fl = m.clone(attn="flash")._local_attn_fn(64)
+    assert "flash" in fl.func.__name__
+    # booleans override the attn field; attn_fn is strongest
+    assert "flash" in m.clone(
+        flash_attn=True)._local_attn_fn(64).func.__name__
+    sentinel = lambda q, k, v, scale: q  # noqa: E731
+    assert m.clone(attn_fn=sentinel)._local_attn_fn(4096) is sentinel
+    with pytest.raises(ValueError, match="attn="):
+        m.clone(attn="fancy").init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_attn_auto_equals_dense_at_small_t():
+    """Below the blockwise threshold the default model is bitwise the
+    dense one — auto cannot perturb small-shape users."""
+    from distkeras_tpu.models import TransformerLM
+
+    m = TransformerLM(vocab_size=41, num_layers=1, d_model=32,
+                      num_heads=4, max_len=64, dtype="float32")
+    toks = jax.random.randint(jax.random.key(0), (2, 24), 0, 41)
+    v = m.init(jax.random.key(1), toks)
+    np.testing.assert_array_equal(
+        np.asarray(m.apply(v, toks)),
+        np.asarray(m.clone(attn="dense").apply(v, toks)))
+
+
+def test_attn_auto_picks_blockwise_at_1024_and_matches_dense():
+    """At T=1024 the default model runs the blockwise spelling (the
+    measured winner at this shape) and agrees with dense numerics up
+    to f32 reduction order."""
+    from distkeras_tpu.models import TransformerLM
+
+    m = TransformerLM(vocab_size=41, num_layers=1, d_model=32,
+                      num_heads=4, max_len=1024, dtype="float32")
+    toks = jax.random.randint(jax.random.key(2), (1, 1024), 0, 41)
+    v = m.init(jax.random.key(3), toks)
+    auto = m.apply(v, toks)
+    np.testing.assert_array_equal(
+        np.asarray(auto),
+        np.asarray(m.clone(attn="blockwise").apply(v, toks)))
+    np.testing.assert_allclose(
+        np.asarray(auto),
+        np.asarray(m.clone(attn="dense").apply(v, toks)),
+        rtol=2e-4, atol=2e-4)
