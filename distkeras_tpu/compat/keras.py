@@ -21,11 +21,11 @@ GlobalAveragePooling2D, Embedding, BatchNormalization, LSTM, GRU
 (``reset_after=True``, the keras >= 2.3 default), SimpleRNN,
 Bidirectional(LSTM|GRU) — the reference's IMDB workflow shape — plus
 the merge layers (Add / Subtract / Multiply / Average / Maximum /
-Concatenate) for functional DAGs, and NESTED ``Sequential`` submodels
-used as layers (inlined; shared nested encoders — the siamese idiom —
-apply one parameter set per call).  Nested functional submodels and
-anything else raise with the layer name so the gap is visible, not
-silent.
+Concatenate) for functional DAGs, and NESTED submodels used as layers —
+both ``Sequential`` stacks and single-input/single-output functional
+graphs (replayed inline; shared nested encoders — the siamese idiom —
+apply one parameter set per call).  Anything else raises with the
+layer name so the gap is visible, not silent.
 
 Model topologies: ``Sequential``; functional ``Model(inputs,
 outputs)`` graphs — linear chains lower to the ``keras_sequential``
@@ -115,10 +115,23 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
             raise ValueError("nested Sequential contains no layers")
         return {"kind": "nested", "layers": sub}
     if class_name in ("Functional", "Model"):
-        raise NotImplementedError(
-            "nested functional submodels are not supported (nested "
-            "Sequential is); flatten the inner graph into the outer "
-            "model or rebuild natively")
+        # a nested functional submodel used as a layer (the shared-
+        # encoder idiom with internal branches/merges): parse its DAG
+        # with the same walker as a top-level functional model and
+        # carry the graph spec; apply/weight-consumption replay it
+        # inline.  Single-tensor boundary only — a nested model's
+        # call site in the outer graph is one tensor in, one out.
+        graph = _parse_functional({"class_name": "Functional",
+                                   "config": cfg})
+        if len(graph["outputs"]) != 1:
+            raise NotImplementedError(
+                "nested functional submodels must have exactly one "
+                f"output; got {len(graph['outputs'])}")
+        if graph["input_slices"]:
+            raise NotImplementedError(
+                "nested functional submodels must have exactly one "
+                "input")
+        return {"kind": "nested_graph", "graph": graph}
     if class_name == "Dense":
         return {"kind": "dense", "units": int(cfg["units"]),
                 "use_bias": bool(cfg.get("use_bias", True)),
@@ -706,6 +719,22 @@ def _apply_layer(layer, name: str, x, dtype, train: bool,
             x = _apply_layer(sub, f"{name}_s{i}", x, dtype, train,
                              memo=sub_memo)
         return x
+    if kind == "nested_graph":
+        # nested functional submodel: replay its call graph inline via
+        # the shared walker.  Sublayers are named {name}_g{param}
+        # (param = inner config position, the inner get_weights()
+        # order).  The memo is ALWAYS a dict here — even under the
+        # sequential lowering (outer memo None), an inner layer shared
+        # across inner call nodes must apply one flax module, or the
+        # second creation of the same explicit name would crash flax;
+        # a fresh local dict is correct there because a sequential
+        # outer layer is applied exactly once.
+        g = layer["graph"]
+        memos = memo.setdefault("g", {}) if memo is not None else {}
+        outs = _walk_graph(g["nodes"], g["topo"], lambda nid: x,
+                           lambda p: f"{name}_g{p}", dtype, train,
+                           memos)
+        return outs[int(g["outputs"][0])]
     if kind == "dense":
         # contracts the last axis, any rank — keras semantics
         x = get("m", lambda: nn.Dense(
@@ -791,6 +820,47 @@ def _make_cell(base: str, layer, dtype, name: str):
                          dtype=dtype, name=name)
 
 
+def _walk_graph(nodes, topo, input_value, name_for, dtype,
+                train: bool, memos: dict):
+    """Execute a parsed call graph: the one walker behind both
+    ``KerasGraph.__call__`` and nested functional submodel replay.
+
+    ``input_value(nid)`` supplies each input node's tensor (the
+    top-level graph resolves multi-input column slices there; nested
+    graphs are single-input and feed the call-site tensor).
+    ``name_for(param)`` names parameterized submodules; ``memos``
+    (param id -> created submodules) makes every call of a shared
+    layer apply ONE flax module — keras's sharing semantics.
+    Returns the full ``{call id: tensor}`` map."""
+    by_id = {int(n["id"]): n for n in nodes}
+    outs: dict[int, Any] = {}
+    for nid in topo:
+        node = by_id[int(nid)]
+        kind = node["kind"]
+        if kind == "input":
+            outs[int(nid)] = input_value(int(nid))
+            continue
+        ins = [outs[int(i)] for i in node["inputs"]]
+        if kind.startswith("merge_"):
+            outs[int(nid)] = _apply_merge(kind, ins, node)
+        else:
+            p = int(node.get("param", node["id"]))
+            outs[int(nid)] = _apply_layer(
+                node, name_for(p), ins[0], dtype, train,
+                memo=memos.setdefault(p, {}))
+    return outs
+
+
+def _graph_param_layers(graph: Mapping[str, Any]) -> dict:
+    """``{param id: node}`` with one entry per LAYER (a shared layer's
+    call nodes collapse to its first node) — the keras
+    ``get_weights()`` unit."""
+    seen: dict[int, Mapping[str, Any]] = {}
+    for n in graph["nodes"]:
+        seen.setdefault(int(n.get("param", n["id"])), n)
+    return seen
+
+
 def _apply_merge(kind: str, ins, layer=None):
     if kind == "merge_concat":
         axis = int(layer.get("axis", -1)) if layer else -1
@@ -864,35 +934,23 @@ class KerasGraph(nn.Module):
     def __call__(self, x, train: bool = False):
         dtype = jnp.dtype(self.dtype)
         x = jnp.asarray(x, dtype)
-        by_id = {int(n["id"]): n for n in self.nodes}
         slices = {int(s[0]): (int(s[1]), int(s[2]),
                               tuple(int(d) for d in s[3])
                               if len(s) > 3 else None)
                   for s in self.input_slices}
-        outs: dict[int, Any] = {}
-        memos: dict[int, dict] = {}  # param id -> created submodules
-        for nid in self.topo:
-            node = by_id[int(nid)]
-            kind = node["kind"]
-            if kind == "input":
-                if int(nid) in slices:
-                    a, b, dims = slices[int(nid)]
-                    piece = x[..., a:b]
-                    if dims is not None:
-                        piece = piece.reshape(
-                            piece.shape[:-1] + dims)
-                    outs[int(nid)] = piece
-                else:
-                    outs[int(nid)] = x
-                continue
-            ins = [outs[int(i)] for i in node["inputs"]]
-            if kind.startswith("merge_"):
-                outs[int(nid)] = _apply_merge(kind, ins, node)
-            else:
-                param = int(node.get("param", node["id"]))
-                outs[int(nid)] = _apply_layer(
-                    node, f"layer_{param}", ins[0], dtype, train,
-                    memo=memos.setdefault(param, {}))
+
+        def input_value(nid: int):
+            if nid in slices:
+                a, b, dims = slices[nid]
+                piece = x[..., a:b]
+                if dims is not None:
+                    piece = piece.reshape(piece.shape[:-1] + dims)
+                return piece
+            return x
+
+        outs = _walk_graph(self.nodes, self.topo, input_value,
+                           lambda p: f"layer_{p}", dtype, train,
+                           memos={})
         if self.outputs:
             result = tuple(outs[int(o)] for o in self.outputs)
             return result[0] if len(result) == 1 else result
@@ -944,9 +1002,7 @@ def _map_graph_weights(graph: dict,
     id), in config-list order — keras lists each layer's arrays once
     in ``get_weights()`` no matter how many times it is called, and
     all of a shared layer's call nodes apply that single set."""
-    seen: dict[int, Mapping[str, Any]] = {}
-    for n in graph["nodes"]:
-        seen.setdefault(int(n.get("param", n["id"])), n)
+    seen = _graph_param_layers(graph)
     return _map_named_weights(
         [(f"layer_{p}", seen[p]) for p in sorted(seen)], weights)
 
@@ -1018,6 +1074,14 @@ def _consume_layers(named_layers, take, params, batch_stats):
             _consume_layers(
                 [(f"{name}_s{i}", sub)
                  for i, sub in enumerate(layer["layers"])],
+                take, params, batch_stats)
+        elif kind == "nested_graph":
+            # nested functional: arrays inline at the submodel's
+            # position, one entry per inner LAYER (param id) in inner
+            # config order — shared inner calls consume one set
+            seen = _graph_param_layers(layer["graph"])
+            _consume_layers(
+                [(f"{name}_g{p}", seen[p]) for p in sorted(seen)],
                 take, params, batch_stats)
         elif kind in ("dense", "conv2d", "conv1d"):
             entry = {"kernel": take()}

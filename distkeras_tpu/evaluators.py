@@ -261,29 +261,62 @@ def metrics_from_logits(logits, labels, *,
 
 def evaluate_model(model, variables: Mapping, dataset: Dataset, *,
                    features_col: str = "features",
-                   label_col: str = "label",
+                   label_col="label",
                    batch_size: int = 512,
-                   top_k: int | None = None) -> dict[str, float]:
+                   top_k: int | None = None) -> dict:
     """One-call evaluation for a trained model: sharded batch inference
-    to logits, then ``metrics_from_logits``."""
+    to logits, then ``metrics_from_logits``.
+
+    Multi-OUTPUT models (e.g. an ingested two-head keras DAG): pass
+    ``label_col`` as a sequence naming one label column per head, in
+    the model's output order — returns ``{label_col: metrics}`` per
+    head instead of one flat metrics dict.  A multi-output model with
+    a scalar ``label_col`` still fails loudly (silently scoring head 0
+    against the only label would be the reference's kind of quiet
+    wrong answer)."""
     predictor = ModelPredictor(model, variables,
                                features_col=features_col,
                                output="logits", batch_size=batch_size)
-    if predictor.spec is not None and len(
-            predictor.spec.kwargs.get("outputs", ())) > 1:
+    multi = isinstance(label_col, (list, tuple))
+    if (not multi and predictor.spec is not None and len(
+            predictor.spec.kwargs.get("outputs", ())) > 1):
         # known multi-output spec: refuse before paying the inference
         raise NotImplementedError(
-            "evaluate_model needs a single-output model (one logits "
-            "head against one label column); this spec has "
-            f"{len(predictor.spec.kwargs['outputs'])} heads — "
-            "evaluate each via ModelPredictor + metrics_from_logits")
+            "evaluate_model with a scalar label_col needs a "
+            "single-output model; this spec has "
+            f"{len(predictor.spec.kwargs['outputs'])} heads — pass "
+            "label_col=[...] naming one label column per head (in "
+            "output order) to evaluate them all")
     scored = predictor.predict(dataset)
+    if multi:
+        if "prediction" in scored.column_names:  # single-head model
+            if len(label_col) == 1:
+                return {label_col[0]: metrics_from_logits(
+                    scored["prediction"], dataset[label_col[0]],
+                    top_k=top_k)}
+            raise ValueError(
+                f"label_col={list(label_col)} names "
+                f"{len(label_col)} heads but the model has 1")
+        n_heads = len([c for c in scored.column_names
+                       if c.startswith("prediction_")])
+        if n_heads != len(label_col):
+            # a head-count mismatch in EITHER direction is loud —
+            # silently scoring the first len(label_col) heads would be
+            # exactly the quiet wrong answer this guard exists for
+            raise ValueError(
+                f"label_col={list(label_col)} names "
+                f"{len(label_col)} heads but the model produced "
+                f"{n_heads} — pass exactly one label column per "
+                "head, in output order")
+        heads = [f"prediction_{i}" for i in range(len(label_col))]
+        return {lab: metrics_from_logits(scored[h], dataset[lab],
+                                         top_k=top_k)
+                for h, lab in zip(heads, label_col)}
     if "prediction" not in scored.column_names:
         raise NotImplementedError(
-            "evaluate_model needs a single-output model (one logits "
-            "head against one label column); this model produced "
-            f"columns {sorted(scored.column_names)} — evaluate each "
-            "head separately via metrics_from_logits(scored["
-            "'prediction_i'], labels_i)")
+            "evaluate_model with a scalar label_col needs a "
+            "single-output model; this model produced columns "
+            f"{sorted(scored.column_names)} — pass label_col=[...] "
+            "naming one label column per head (in output order)")
     return metrics_from_logits(scored["prediction"],
                                dataset[label_col], top_k=top_k)

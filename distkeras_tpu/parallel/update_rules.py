@@ -204,7 +204,8 @@ def apply_commit_round(rule: UpdateRule, state: PSState,
 
 
 def apply_commit_round_pulls(rule: UpdateRule, state: PSState,
-                             payloads: Pytree, locals_: Pytree | None
+                             payloads: Pytree, locals_: Pytree | None,
+                             staleness_offset: int = 0
                              ) -> tuple[PSState, Pytree]:
     """Sequential commit round with the pulls computed in-scan.
 
@@ -222,6 +223,12 @@ def apply_commit_round_pulls(rule: UpdateRule, state: PSState,
     value (``pull_uses_local = False`` — the delta family), which keeps the
     scan free of an unused ``[N, params]`` operand.
 
+    ``staleness_offset`` adds a constant to every commit's staleness —
+    the pipelined round (``ps_emulator.make_pipelined_round_fn``) uses
+    it to account for the extra round of commits its windows run
+    behind, so staleness-aware rules (DynSGD) see the TRUE commit
+    depth.
+
     Returns ``(new_state, pulled)`` with ``pulled`` stacked in commit order.
     """
     base_clock = state.clock
@@ -229,7 +236,7 @@ def apply_commit_round_pulls(rule: UpdateRule, state: PSState,
 
     def step(st, inp):
         payload_i, local_i = inp if with_locals else (inp, None)
-        staleness = st.clock - base_clock
+        staleness = st.clock - base_clock + staleness_offset
         new_st = rule.commit(st, payload_i, staleness)
         pulled_i = rule.worker_pull(local_i, st.center, new_st.center)
         return new_st, pulled_i

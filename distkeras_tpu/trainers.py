@@ -25,6 +25,7 @@ SURVEY.md §5 "honest observability").
 
 from __future__ import annotations
 
+import functools
 import os
 import pathlib
 import queue
@@ -746,6 +747,13 @@ class DistributedTrainer(Trainer):
     ``communication_window``, worker placement on the mesh, emulated
     commit rounds."""
 
+    #: effective per-round lr (configured lr x family amplification)
+    #: above which the staleness families measurably degrade on the
+    #: PARITY.md calibration task (MNIST MLP, sgd workers): the
+    #: collapsing configs sit at 0.2-0.8, every law-scaled PARITY row
+    #: at <= 0.1.  A heuristic guardrail, not a convergence proof.
+    _LR_LAW_EFFECTIVE_MAX = 0.1
+
     def __init__(self, model, num_workers: int = 2,
                  communication_window: int = 5,
                  fidelity: str = "faithful",
@@ -755,7 +763,9 @@ class DistributedTrainer(Trainer):
                  worker_retries: int = 0,
                  worker_timeout: float | None = None,
                  fault_injector=None, compression=None,
-                 model_parallel: int = 1, tp_rules=None, **kwargs):
+                 model_parallel: int = 1, tp_rules=None,
+                 lr_law: str = "warn",
+                 commit_overlap: bool = False, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -827,6 +837,83 @@ class DistributedTrainer(Trainer):
                 "fidelity='host' (the emulated arms are deterministic; "
                 "recover via checkpoint/resume), got "
                 f"fidelity={fidelity!r}")
+        self.commit_overlap = bool(commit_overlap)
+        if self.commit_overlap and fidelity != "faithful":
+            raise ValueError(
+                "commit_overlap pipelines the faithful commit scan "
+                "against the next window; it requires "
+                "fidelity='faithful' (the fast/host arms have no "
+                f"separate commit phase to overlap), got {fidelity!r}")
+        if self.commit_overlap and (checkpoint_every_rounds
+                                    or kwargs.get("checkpoint_dir")):
+            raise ValueError(
+                "commit_overlap runs one commit round behind — "
+                "mid-training checkpoints would snapshot a center "
+                "missing the pending round; train without "
+                "checkpointing or without commit_overlap")
+        if lr_law not in ("warn", "scale", "off"):
+            raise ValueError(
+                f"lr_law={lr_law!r} must be 'warn' (default: warn "
+                "when the configured lr violates the measured "
+                "per-family stability law), 'scale' (divide lr by "
+                "the family's amplification factor), or 'off'")
+        self.lr_law = lr_law
+        self._apply_lr_law()
+
+    def _lr_law(self):
+        """``(amplification, scale_divisor, law)`` for this family, or
+        ``None``.
+
+        The staleness families amplify the configured lr per PS round
+        (PARITY.md "per-family learning-rate scaling laws", measured
+        on the calibration task): DOWNPOUR commits raw window-summed
+        deltas from every worker (x workers*window), ADAG normalizes
+        the window but still sums worker commits (x workers), DynSGD's
+        1/(staleness+1) divides the commit depth but not the window
+        sum (x window), EAMSGD's Nesterov workers amplify ~1/(1-m).
+        ``amplification`` drives the warning threshold;
+        ``scale_divisor`` is the MEASURED correction ``lr_law='scale'``
+        applies — equal for most families, but EAMSGD's measured law
+        row is lr/2, not lr(1-m) (momentum amplification is transient,
+        not a steady-state divisor).  The elastic exchange itself is
+        lr-neutral (AEASGD: the rho x lr sweep is flat), so the AEASGD
+        base declares no law."""
+        return None
+
+    def _apply_lr_law(self) -> None:
+        """The library-side guardrail for the measured footguns the
+        round-3/4 parity campaign documented only in prose (PARITY.md:
+        DOWNPOUR at window 4 collapses to 0.26 accuracy unless the lr
+        follows the family law).  ``lr_law='warn'`` (default) warns
+        when lr x amplification exceeds the measured stability scale;
+        ``'scale'`` applies the measured law (divides lr), matching
+        what examples/compare_trainers.py hand-codes; ``'off'``
+        silences informed users."""
+        law = self._lr_law()
+        if law is None or self.lr_law == "off":
+            return
+        factor, divisor, suggestion = law
+        try:
+            lr = float(self.learning_rate)
+        except (TypeError, ValueError):
+            return  # schedules: the law is about constant-lr configs
+        if self.lr_law == "scale":
+            self.learning_rate = lr / divisor
+            return
+        effective = lr * factor
+        if effective > self._LR_LAW_EFFECTIVE_MAX:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: learning_rate={lr:g} is "
+                f"amplified ~{factor:g}x per PS round by this "
+                f"family's update law (effective {effective:g} > "
+                f"{self._LR_LAW_EFFECTIVE_MAX} — the measured "
+                "stability scale; PARITY.md 'per-family learning-"
+                f"rate scaling laws').  Consider {suggestion}, pass "
+                "lr_law='scale' to apply it automatically, or "
+                "lr_law='off' if this lr is deliberate.",
+                UserWarning, stacklevel=3)
 
     def allocate_rule(self) -> UpdateRule:
         raise NotImplementedError
@@ -893,7 +980,25 @@ class DistributedTrainer(Trainer):
 
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
-        round_fn = make_round_fn(rule, step, self.fidelity)
+        overlap = self.commit_overlap
+        if overlap:
+            if resume_from is not None:
+                raise ValueError(
+                    "commit_overlap cannot resume from a checkpoint "
+                    "(the pipelined round carries an uncheckpointed "
+                    "pending commit)")
+            if self.model_parallel > 1:
+                raise ValueError(
+                    "commit_overlap supports data-parallel workers "
+                    "only (model_parallel=1)")
+            from distkeras_tpu.parallel.ps_emulator import (
+                flush_pending, make_pipelined_round_fn)
+
+            round_fn = make_pipelined_round_fn(rule, step)
+            flush_fn = functools.partial(flush_pending, rule,
+                                         num_workers=num_workers)
+        else:
+            round_fn = make_round_fn(rule, step, self.fidelity)
         ps_state = rule.init_state(center)
         perm_key = jax.random.key(self.seed + 2)
 
@@ -970,10 +1075,22 @@ class DistributedTrainer(Trainer):
                 perm_key = jax.random.wrap_key_data(jnp.asarray(
                     np.asarray(cursor.pop("perm_key_data"),
                                np.uint32)))
-            round_jit = jax.jit(
-                round_fn,
-                in_shardings=(ps_sharding, ws_sharding, row, rep),
-                out_shardings=(ps_sharding, ws_sharding, rep))
+            if overlap:
+                round_jit = jax.jit(
+                    round_fn,
+                    in_shardings=(ps_sharding, ws_sharding, row, rep,
+                                  row, rep, rep),
+                    out_shardings=(ps_sharding, ws_sharding, rep, row,
+                                   rep, rep))
+                flush_jit = jax.jit(
+                    flush_fn,
+                    in_shardings=(ps_sharding, row, rep),
+                    out_shardings=ps_sharding)
+            else:
+                round_jit = jax.jit(
+                    round_fn,
+                    in_shardings=(ps_sharding, ws_sharding, row, rep),
+                    out_shardings=(ps_sharding, ws_sharding, rep))
             # worker-0 row of the model state (batch stats etc.),
             # sliced on device; jitted ONCE so epoch-boundary eval and
             # the end-of-train extraction share the compiled program
@@ -986,6 +1103,8 @@ class DistributedTrainer(Trainer):
                     f"{resume_from!r} holds a sharded checkpoint but "
                     f"this run has no mesh to restore it onto")
             round_jit = jax.jit(round_fn)
+            if overlap:
+                flush_jit = jax.jit(flush_fn)
             slice_row0 = lambda t: jax.tree_util.tree_map(  # noqa: E731
                 lambda x: x[0], t)
 
@@ -993,6 +1112,25 @@ class DistributedTrainer(Trainer):
         start_round = int(cursor.get("round", 0))
         rows_per_worker_batch = self.batch_size
         cols = self._columns()
+
+        if overlap:
+            # the pipelined round's carried pending commit: a zero
+            # delta (inert for the delta family) until the first round
+            # marks it valid; pend_live mirrors validity host-side so
+            # the epoch-end flush doesn't fetch a device bool
+            pend_payloads = jax.tree_util.tree_map(
+                jnp.zeros_like, worker_states.params)
+            if placement.mesh is not None:
+                pend_perm = mesh_lib.global_batch_from_local(
+                    rep, np.arange(num_workers, dtype=np.int32))
+                pend_valid = mesh_lib.global_batch_from_local(
+                    rep, np.asarray(False))
+                _false = pend_valid
+            else:
+                pend_perm = jnp.arange(num_workers, dtype=jnp.int32)
+                pend_valid = jnp.asarray(False)
+                _false = pend_valid
+            pend_live = False
 
         def save_point(point: dict):
             # reads the loop's current ps/worker/key state at call time
@@ -1156,8 +1294,16 @@ class DistributedTrainer(Trainer):
                     else:
                         batch = {k: jnp.asarray(v)
                                  for k, v in batch.items()}
-                    ps_state, worker_states, metrics = round_jit(
-                        ps_state, worker_states, batch, perm)
+                    if overlap:
+                        (ps_state, worker_states, metrics,
+                         pend_payloads, pend_perm, pend_valid) = \
+                            round_jit(ps_state, worker_states, batch,
+                                      perm, pend_payloads, pend_perm,
+                                      pend_valid)
+                        pend_live = True
+                    else:
+                        ps_state, worker_states, metrics = round_jit(
+                            ps_state, worker_states, batch, perm)
                     if pending is not None:
                         drain(pending)
                     pending = metrics
@@ -1181,6 +1327,14 @@ class DistributedTrainer(Trainer):
                     f"communication window ({window}) in any segment")
             if pending is not None:
                 drain(pending)
+            if overlap and pend_live:
+                # the pipeline always runs one commit behind: fold the
+                # final pending round in so epoch-boundary eval (and
+                # the returned model) see every commit
+                ps_state = flush_jit(ps_state, pend_payloads,
+                                     pend_perm)
+                pend_valid = _false
+                pend_live = False
             self._record(epoch_loss=float(np.mean(epoch_losses)),
                          segment_stall_s=round(seg_stall, 4))
             if getattr(self, "_eval_dataset", None) is not None:
@@ -1738,6 +1892,11 @@ class DOWNPOUR(DistributedTrainer):
     def allocate_rule(self):
         return DownpourRule()
 
+    def _lr_law(self):
+        f = self.num_workers * self.communication_window
+        return (f, f, "learning_rate / (num_workers * "
+                "communication_window)")
+
 
 class ADAG(DistributedTrainer):
     """Asynchronous Distributed Adaptive Gradients — window-normalized
@@ -1746,12 +1905,20 @@ class ADAG(DistributedTrainer):
     def allocate_rule(self):
         return AdagRule()
 
+    def _lr_law(self):
+        return (self.num_workers, self.num_workers,
+                "learning_rate / num_workers")
+
 
 class DynSGD(DistributedTrainer):
     """Staleness-scaled commits (reference ``DynSGD``)."""
 
     def allocate_rule(self):
         return DynSGDRule()
+
+    def _lr_law(self):
+        return (self.communication_window, self.communication_window,
+                "learning_rate / communication_window")
 
 
 class AEASGD(DistributedTrainer):
@@ -1786,8 +1953,23 @@ class EAMSGD(AEASGD):
 
     def __init__(self, model, momentum: float = 0.9, **kwargs):
         kwargs.setdefault("worker_optimizer", "nesterov")
-        super().__init__(model, **kwargs)
+        # before super(): _apply_lr_law runs in the base __init__ and
+        # EAMSGD's law reads the momentum
         self.momentum = momentum
+        super().__init__(model, **kwargs)
+
+    def _lr_law(self):
+        if self.worker_optimizer != "nesterov" or self.momentum >= 1:
+            return super()._lr_law()
+        # Nesterov workers amplify the effective step ~1/(1-m)
+        # transiently (10x at the default m=0.9) — that drives the
+        # warning threshold — but the MEASURED correction is lr/2
+        # (PARITY.md's "momentum law" row restores 0.99): momentum
+        # amplification is transient, so dividing by the full 1/(1-m)
+        # would under-train 5x below the measured parity lr.
+        return (1.0 / (1.0 - self.momentum), 2.0,
+                "learning_rate / 2 (the measured momentum-law row "
+                "at the default momentum=0.9)")
 
     def _tx(self):
         if self.worker_optimizer == "nesterov":

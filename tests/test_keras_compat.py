@@ -294,12 +294,66 @@ def test_shared_nested_encoder_siamese_parity(_f32_matmuls):
         np.asarray(m([xa, xb])), rtol=1e-5, atol=1e-5)
 
 
-def test_nested_functional_rejected_loudly():
+def test_nested_functional_submodel_parity(_f32_matmuls):
+    """VERDICT r4 #8: a functional Model (with internal branches and a
+    merge) used as a layer ingests by replaying its DAG inline — exact
+    forward parity, weights consumed at the submodel's position."""
+    inner_in = keras.Input((6,))
+    a = keras.layers.Dense(6, activation="relu")(inner_in)
+    b = keras.layers.Dense(6, activation="tanh")(inner_in)
+    inner = keras.Model(inner_in, keras.layers.Add()([a, b]))
+    outer_in = keras.Input((6,))
+    m = keras.Model(outer_in,
+                    keras.layers.Dense(2)(inner(outer_in)))
+    spec, variables = from_keras(m)
+    x = np.random.default_rng(4).normal(size=(5, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
+    # the spec (carrying the inner graph) survives JSON round-trip
+    rebuilt = json.loads(json.dumps(spec.to_config()))
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(
+            __import__("distkeras_tpu.models", fromlist=["ModelSpec"]
+                       ).ModelSpec.from_config(rebuilt).build().apply(
+                           variables, x)),
+        rtol=1e-6, atol=1e-6)
+
+
+def test_shared_nested_functional_siamese_parity(_f32_matmuls):
+    """One nested functional encoder called on two inputs — one
+    parameter set (keras sharing semantics), exact parity."""
+    enc_in = keras.Input((4,))
+    h = keras.layers.Dense(6, activation="relu")(enc_in)
+    enc = keras.Model(enc_in, keras.layers.Dense(6)(h))
+    a = keras.Input((4,), name="left")
+    b = keras.Input((4,), name="right")
+    joined = keras.layers.Concatenate()([enc(a), enc(b)])
+    m = keras.Model([a, b], keras.layers.Dense(2)(joined))
+    spec, variables = from_keras(m)
+    rng = np.random.default_rng(5)
+    xa = rng.normal(size=(5, 4)).astype(np.float32)
+    xb = rng.normal(size=(5, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(
+            variables, np.concatenate([xa, xb], axis=1))),
+        np.asarray(m([xa, xb])), rtol=1e-5, atol=1e-5)
+
+
+def test_nested_functional_multi_output_rejected():
+    """A nested submodel's call site is one tensor in, one out — a
+    multi-output inner model cannot ingest and must say so."""
     inner_in = keras.Input((4,))
-    inner = keras.Model(inner_in, keras.layers.Dense(3)(inner_in))
+    inner = keras.Model(inner_in, [keras.layers.Dense(3)(inner_in),
+                                   keras.layers.Dense(2)(inner_in)])
     outer_in = keras.Input((4,))
-    m = keras.Model(outer_in, keras.layers.Dense(2)(inner(outer_in)))
-    with pytest.raises(NotImplementedError, match="nested functional"):
+    outs = inner(outer_in)
+    m = keras.Model(outer_in,
+                    keras.layers.Concatenate()(list(outs)))
+    # rejected by the graph walker's multi-output-layer guard (the
+    # nested model is one layer with two output tensors)
+    with pytest.raises(NotImplementedError, match="multi-output"):
         from_keras(m)
 
 
@@ -698,3 +752,88 @@ def test_ingested_bilstm_trains():
                       learning_rate=5e-3, batch_size=32, num_epoch=2)
     t.train(data, initial_variables=variables)
     assert np.isfinite(t.history["epoch_loss"]).all()
+
+
+def test_two_head_evaluate_model(_f32_matmuls):
+    """VERDICT r4 #8: evaluate_model scores a multi-output model when
+    label_col names one label column per head; a scalar label_col
+    still fails loudly (never silently scores head 0)."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import evaluate_model
+
+    inp = keras.Input((8,))
+    h = keras.layers.Dense(16, activation="relu")(inp)
+    m = keras.Model(inp, [keras.layers.Dense(3, name="head_a")(h),
+                          keras.layers.Dense(2, name="head_b")(h)])
+    spec, variables = from_keras(m)
+    rng = np.random.default_rng(6)
+    data = Dataset({
+        "features": rng.normal(size=(64, 8)).astype(np.float32),
+        "label_a": rng.integers(0, 3, size=64),
+        "label_b": rng.integers(0, 2, size=64),
+    })
+    with pytest.raises(NotImplementedError, match="label_col"):
+        evaluate_model(spec, variables, data, label_col="label_a")
+    got = evaluate_model(spec, variables, data,
+                         label_col=["label_a", "label_b"])
+    assert set(got) == {"label_a", "label_b"}
+    for head in got.values():
+        assert 0.0 <= head["accuracy"] <= 1.0
+    # per-head numbers equal the single-head math on that head's logits
+    from distkeras_tpu.evaluators import metrics_from_logits
+    from distkeras_tpu.predictors import ModelPredictor
+
+    scored = ModelPredictor(spec, variables,
+                            output="logits").predict(data)
+    want_a = metrics_from_logits(scored["prediction_0"],
+                                 data["label_a"])
+    assert got["label_a"] == want_a
+    # head-count mismatch is loud
+    with pytest.raises(ValueError, match="heads"):
+        evaluate_model(spec, variables, data,
+                       label_col=["label_a", "label_b", "label_a"])
+
+
+def test_nested_functional_shared_inner_layer_in_chain(_f32_matmuls):
+    """Review regression: an outer CHAIN-shaped model containing a
+    nested functional submodel whose inner layer is called twice
+    lowers to the sequential family (memo-less apply path) — the
+    inner sharing must still create ONE flax module, not crash on a
+    duplicate name."""
+    inner_in = keras.Input((6,))
+    shared = keras.layers.Dense(6, activation="relu", name="twice")
+    inner = keras.Model(inner_in,
+                        keras.layers.Add()([shared(inner_in),
+                                            shared(inner_in)]))
+    outer_in = keras.Input((6,))
+    m = keras.Model(outer_in, keras.layers.Dense(2)(inner(outer_in)))
+    spec, variables = from_keras(m)
+    assert spec.to_config()["family"] == "keras_sequential"
+    x = np.random.default_rng(7).normal(size=(4, 6)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(spec.build().apply(variables, x)),
+        np.asarray(m(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_evaluate_model_undercounted_heads_rejected(_f32_matmuls):
+    """Review regression: label_col naming FEWER heads than the model
+    has must raise, never silently score the first heads."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.evaluators import evaluate_model
+
+    inp = keras.Input((8,))
+    m = keras.Model(inp, [keras.layers.Dense(3)(inp),
+                          keras.layers.Dense(2)(inp)])
+    spec, variables = from_keras(m)
+    rng = np.random.default_rng(8)
+    data = Dataset({
+        "features": rng.normal(size=(32, 8)).astype(np.float32),
+        "label_b": rng.integers(0, 2, size=32),
+    })
+    with pytest.raises(ValueError, match="heads"):
+        evaluate_model(spec, variables, data, label_col=["label_b"])
+    # single-head model + 1-element list works (returns per-head form)
+    m1 = keras.Model(inp, keras.layers.Dense(2)(inp))
+    spec1, v1 = from_keras(m1)
+    got = evaluate_model(spec1, v1, data, label_col=["label_b"])
+    assert set(got) == {"label_b"} and "accuracy" in got["label_b"]
