@@ -16,7 +16,8 @@ weights are plain arrays.  When Keras *is* installed, ``from_keras``
 takes a live model.
 
 Supported layers: InputLayer, Dense, Activation, Dropout, Flatten,
-Conv1D, Conv2D, SeparableConv2D, MaxPooling2D, AveragePooling2D,
+Conv1D, Conv2D (incl. dilated and grouped), DepthwiseConv2D,
+Conv2DTranspose, SeparableConv2D, MaxPooling2D, AveragePooling2D,
 GlobalAveragePooling2D, Embedding, BatchNormalization, LSTM, GRU
 (``reset_after=True``, the keras >= 2.3 default), SimpleRNN,
 Bidirectional(LSTM|GRU) — the reference's IMDB workflow shape — plus
@@ -147,16 +148,12 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
         if cfg.get("data_format") not in (None, "channels_last"):
             raise NotImplementedError(
                 "only channels_last Conv2D is supported")
-        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
-            raise NotImplementedError(
-                "dilated Conv2D is not supported")
-        if int(cfg.get("groups", 1)) != 1:
-            raise NotImplementedError(
-                "grouped Conv2D is not supported")
         return {"kind": "conv2d", "filters": int(cfg["filters"]),
                 "kernel_size": list(_pair(cfg["kernel_size"])),
                 "strides": list(_pair(cfg.get("strides", 1))),
                 "padding": str(cfg.get("padding", "valid")).upper(),
+                "dilation": list(_pair(cfg.get("dilation_rate", 1))),
+                "groups": int(cfg.get("groups", 1)),
                 "use_bias": bool(cfg.get("use_bias", True)),
                 "activation": cfg.get("activation", "linear")}
     if class_name == "Conv1D":
@@ -165,10 +162,6 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
                 "only channels_last Conv1D is supported")
         def one(v):
             return int(v[0]) if isinstance(v, (list, tuple)) else int(v)
-        if one(cfg.get("dilation_rate", 1)) != 1:
-            raise NotImplementedError("dilated Conv1D is not supported")
-        if int(cfg.get("groups", 1)) != 1:
-            raise NotImplementedError("grouped Conv1D is not supported")
         padding = str(cfg.get("padding", "valid")).upper()
         if padding == "CAUSAL":
             raise NotImplementedError(
@@ -177,6 +170,37 @@ def _normalize_layer(class_name: str, cfg: Mapping[str, Any]) -> Optional[dict]:
                 "kernel_size": one(cfg["kernel_size"]),
                 "strides": one(cfg.get("strides", 1)),
                 "padding": padding,
+                "dilation": one(cfg.get("dilation_rate", 1)),
+                "groups": int(cfg.get("groups", 1)),
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
+    if class_name == "DepthwiseConv2D":
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise NotImplementedError(
+                "only channels_last DepthwiseConv2D is supported")
+        return {"kind": "dwconv2d",
+                "kernel_size": list(_pair(cfg["kernel_size"])),
+                "strides": list(_pair(cfg.get("strides", 1))),
+                "padding": str(cfg.get("padding", "valid")).upper(),
+                "dilation": list(_pair(cfg.get("dilation_rate", 1))),
+                "depth_multiplier": int(cfg.get("depth_multiplier", 1)),
+                "use_bias": bool(cfg.get("use_bias", True)),
+                "activation": cfg.get("activation", "linear")}
+    if class_name == "Conv2DTranspose":
+        if cfg.get("data_format") not in (None, "channels_last"):
+            raise NotImplementedError(
+                "only channels_last Conv2DTranspose is supported")
+        if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+            raise NotImplementedError(
+                "dilated Conv2DTranspose is not supported")
+        if cfg.get("output_padding") is not None:
+            raise NotImplementedError(
+                "Conv2DTranspose(output_padding=...) is not supported")
+        return {"kind": "convtranspose2d",
+                "filters": int(cfg["filters"]),
+                "kernel_size": list(_pair(cfg["kernel_size"])),
+                "strides": list(_pair(cfg.get("strides", 1))),
+                "padding": str(cfg.get("padding", "valid")).upper(),
                 "use_bias": bool(cfg.get("use_bias", True)),
                 "activation": cfg.get("activation", "linear")}
     if class_name == "SeparableConv2D":
@@ -752,10 +776,42 @@ def _apply_layer(layer, name: str, x, dtype, train: bool,
                 if kind == "conv2d" else (layer["kernel_size"],))
         strides = (tuple(layer["strides"])
                    if kind == "conv2d" else (layer["strides"],))
+        dilation = layer.get("dilation", 1)
+        dilation = (tuple(dilation) if isinstance(dilation, (list,
+                                                             tuple))
+                    else (dilation,))
         x = get("m", lambda: nn.Conv(
             layer["filters"], size, strides=strides,
             padding=layer["padding"], use_bias=layer["use_bias"],
+            kernel_dilation=dilation,
+            feature_group_count=layer.get("groups", 1),
             dtype=dtype, name=name))(x)
+        return _activation(layer["activation"])(x)
+    if kind == "dwconv2d":
+        # keras DepthwiseConv2D == flax grouped conv with one group
+        # per input channel; keras's [k, k, cin, mult] kernel folds to
+        # flax's [k, k, 1, cin*mult] (same channel order — channel i's
+        # multipliers contiguous), exactly the sepconv dw mapping
+        channels = int(x.shape[-1])
+        mult = layer["depth_multiplier"]
+        x = get("m", lambda: nn.Conv(
+            channels * mult, tuple(layer["kernel_size"]),
+            strides=tuple(layer["strides"]),
+            padding=layer["padding"],
+            kernel_dilation=tuple(layer.get("dilation", (1, 1))),
+            use_bias=layer["use_bias"],
+            feature_group_count=channels,
+            dtype=dtype, name=name))(x)
+        return _activation(layer["activation"])(x)
+    if kind == "convtranspose2d":
+        # transpose_kernel=True takes the kernel in keras's own
+        # [k, k, out, in] layout AND flips it the way keras's
+        # gradient-of-conv semantics do — verified exact vs keras
+        x = get("m", lambda: nn.ConvTranspose(
+            layer["filters"], tuple(layer["kernel_size"]),
+            strides=tuple(layer["strides"]),
+            padding=layer["padding"], use_bias=layer["use_bias"],
+            transpose_kernel=True, dtype=dtype, name=name))(x)
         return _activation(layer["activation"])(x)
     if kind == "sepconv2d":
         channels = int(x.shape[-1])
@@ -1083,8 +1139,17 @@ def _consume_layers(named_layers, take, params, batch_stats):
             _consume_layers(
                 [(f"{name}_g{p}", seen[p]) for p in sorted(seen)],
                 take, params, batch_stats)
-        elif kind in ("dense", "conv2d", "conv1d"):
+        elif kind in ("dense", "conv2d", "conv1d", "convtranspose2d"):
+            # convtranspose2d: flax ConvTranspose(transpose_kernel=
+            # True) stores the kernel in keras's own layout — as-is
             entry = {"kernel": take()}
+            if layer["use_bias"]:
+                entry["bias"] = take()
+            params[name] = entry
+        elif kind == "dwconv2d":
+            dw = take()  # [k, k, cin, mult] -> grouped-conv layout
+            k1, k2, cin, mult = dw.shape
+            entry = {"kernel": dw.reshape(k1, k2, 1, cin * mult)}
             if layer["use_bias"]:
                 entry["bias"] = take()
             params[name] = entry

@@ -46,6 +46,24 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA: number of K/V heads (divides --heads); "
+                         "shrinks the per-token KV-cache read by the "
+                         "group factor (PERF.md §18 addendum)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=[None, "int8"],
+                    help="int8: quantized KV cache (halves the bf16 "
+                         "cache's per-token HBM traffic)")
+    ap.add_argument("--attn", default="auto",
+                    choices=["auto", "dense", "blockwise", "flash"],
+                    help="prefill attention spelling (decode keeps "
+                         "it for 128-aligned prompt chunks)")
+    ap.add_argument("--prompt-lo", type=int, default=None,
+                    help="with --prompt-hi: measure PREFILL marginal "
+                         "cost by differencing two prompt lengths at "
+                         "fixed new tokens (the §18 flash-prefill "
+                         "row); skips the decode measurement")
+    ap.add_argument("--prompt-hi", type=int, default=None)
     args = ap.parse_args()
 
     from distkeras_tpu.models import ModelSpec, generate, model_config
@@ -54,7 +72,8 @@ def main():
         "transformer_lm", (args.max_len,), input_dtype="int32",
         vocab_size=args.vocab, num_layers=args.layers,
         d_model=args.d_model, num_heads=args.heads,
-        max_len=args.max_len, dtype=args.dtype)
+        max_len=args.max_len, dtype=args.dtype, attn=args.attn,
+        num_kv_heads=args.kv_heads, kv_cache_dtype=args.kv_dtype)
     model = ModelSpec.from_config(spec).build()
     tokens = jnp.zeros((args.batch, args.max_len), jnp.int32)
     variables = model.init(jax.random.key(0), tokens[:, :8])
@@ -63,6 +82,41 @@ def main():
     prompt = jax.random.randint(jax.random.key(1),
                                 (args.batch, args.prompt), 0,
                                 args.vocab)
+
+    if args.prompt_lo is not None or args.prompt_hi is not None:
+        if not (args.prompt_lo and args.prompt_hi):
+            raise SystemExit("--prompt-lo and --prompt-hi go together")
+        # prefill marginal cost: t(prompt_hi) - t(prompt_lo) at fixed
+        # new tokens — the tunnel round-trip and the decode tail
+        # cancel, leaving the prefill cost of the extra tokens.  With
+        # --attn flash/auto the 128-aligned prompt runs the Pallas
+        # kernels; --attn dense is the round-4 O(T·max_len) cache read.
+        def timed_prompt(t_len):
+            p = jax.random.randint(jax.random.key(1),
+                                   (args.batch, t_len), 0, args.vocab)
+            f = jax.jit(lambda v, p: generate(model, v, p,
+                                              max_new_tokens=8))
+            host_sync(f(variables, p))
+            t0 = time.perf_counter()
+            for _ in range(args.reps):
+                host_sync(f(variables, p))
+            return (time.perf_counter() - t0) / args.reps
+
+        t_lo = timed_prompt(args.prompt_lo)
+        t_hi = timed_prompt(args.prompt_hi)
+        extra = args.prompt_hi - args.prompt_lo
+        print(json.dumps({
+            "metric": "lm_prefill_marginal",
+            "attn": args.attn,
+            "model": f"lm L{args.layers} d{args.d_model} b{args.batch}",
+            "prompt_lo": args.prompt_lo, "prompt_hi": args.prompt_hi,
+            "prefill_ms_for_extra": round((t_hi - t_lo) * 1e3, 2),
+            "prefill_us_per_token": round(
+                (t_hi - t_lo) / extra / args.batch * 1e6, 2),
+            "t_lo_ms": round(t_lo * 1e3, 2),
+            "t_hi_ms": round(t_hi * 1e3, 2),
+        }))
+        return
 
     # Per-token decode cost by DIFFERENCING two generation lengths:
     # t(new_hi) - t(new_lo) cancels the prompt prefill AND the

@@ -39,7 +39,8 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--vocab", type=int, default=32768)
     ap.add_argument("--reps", type=int, default=10)
-    ap.add_argument("--attn", choices=["dense", "blockwise", "flash"],
+    ap.add_argument("--attn",
+                    choices=["auto", "dense", "blockwise", "flash"],
                     default="dense",
                     help="'blockwise': device-local flash-style "
                          "attention (online-softmax q-chunks, no "
@@ -75,6 +76,7 @@ def main():
         max_len=args.seq_len, dtype="bfloat16",
         num_experts=args.experts,
         remat_blocks=args.remat,
+        attn=args.attn if args.attn in ("auto", "dense") else "auto",
         blockwise_attn=args.attn == "blockwise",
         flash_attn=args.attn == "flash",
         attn_q_chunk=(args.q_chunk if args.attn == "blockwise"
