@@ -56,7 +56,8 @@ class SelfAttention(nn.Module):
     """``cache_len > 0`` switches on autoregressive decode mode: K/V
     projections of every token seen so far persist in a ``"cache"``
     variable collection (``cached_key``/``cached_value`` sized
-    ``[B, cache_len, KVH, D]`` plus an insertion ``cache_index``), and
+    ``[B, KVH, cache_len, D]`` — length contiguous, the measured
+    decode-bandwidth layout — plus an insertion ``cache_index``), and
     each call appends its T tokens and attends back over the whole
     prefix.  A multi-token call (prefill) with an ``attn_fn`` runs the
     chunk through that kernel instead of the dense cache read — exact
@@ -110,7 +111,12 @@ class SelfAttention(nn.Module):
             b, t = x.shape[0], x.shape[1]
             quant = self.kv_cache_dtype == "int8"
             store = jnp.int8 if quant else k.dtype
-            shape = (b, self.cache_len, kvh, head_dim)
+            # [B, KVH, L, D]: the per-step attention contracts over L,
+            # so L must be the contiguous-row axis — the round-5
+            # decode roofline measured the [B, L, KVH, D] layout's
+            # strided reads at ~1/4 effective HBM bandwidth (PERF.md
+            # §18 addendum)
+            shape = (b, kvh, self.cache_len, head_dim)
             ck = self.variable("cache", "cached_key", jnp.zeros, shape,
                                store)
             cv = self.variable("cache", "cached_value", jnp.zeros,
@@ -119,23 +125,23 @@ class SelfAttention(nn.Module):
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
             if quant:
-                sshape = (b, self.cache_len, kvh, 1)
+                sshape = (b, kvh, self.cache_len, 1)
                 ks = self.variable("cache", "key_scale", jnp.zeros,
                                    sshape, jnp.float32)
                 vs = self.variable("cache", "value_scale", jnp.zeros,
                                    sshape, jnp.float32)
                 k_w, k_s = _quantize_kv(k)
                 v_w, v_s = _quantize_kv(v)
-                ks.value = lax.dynamic_update_slice(ks.value, k_s,
-                                                    (0, idx, 0, 0))
-                vs.value = lax.dynamic_update_slice(vs.value, v_s,
-                                                    (0, idx, 0, 0))
+                ks.value = lax.dynamic_update_slice(
+                    ks.value, jnp.swapaxes(k_s, 1, 2), (0, 0, idx, 0))
+                vs.value = lax.dynamic_update_slice(
+                    vs.value, jnp.swapaxes(v_s, 1, 2), (0, 0, idx, 0))
             else:
                 k_w, v_w = k, v
-            ck.value = lax.dynamic_update_slice(ck.value, k_w,
-                                                (0, idx, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v_w,
-                                                (0, idx, 0, 0))
+            ck.value = lax.dynamic_update_slice(
+                ck.value, jnp.swapaxes(k_w, 1, 2), (0, 0, idx, 0))
+            cv.value = lax.dynamic_update_slice(
+                cv.value, jnp.swapaxes(v_w, 1, 2), (0, 0, idx, 0))
             ci.value = idx + t
             # Overflow is a traced condition (cache_index is dynamic),
             # so it cannot raise; dynamic_update_slice would silently
@@ -157,29 +163,41 @@ class SelfAttention(nn.Module):
                 out = self.attn_fn(q, kf, vf, scale=scale)
                 ok = jnp.logical_and(ok, idx == 0)
             else:
-                if quant:
-                    keys = (ck.value.astype(jnp.float32)
-                            * ks.value).astype(q.dtype)
-                    vals = (cv.value.astype(jnp.float32)
-                            * vs.value).astype(q.dtype)
-                else:
-                    keys, vals = ck.value, cv.value
                 # q rows sit at global positions idx..idx+t-1; causal
                 # mask over the full cache (future slots are zeros AND
                 # masked).  The grouped einsum attends each query-head
                 # group to its shared K/V head without materializing a
-                # repeated cache.
+                # repeated cache; the cache's [B, KVH, L, D] layout
+                # keeps the L contraction contiguous.  For the int8
+                # cache the per-row scales FACTOR OUT of both
+                # contractions (they are constant over the contracted
+                # d axis / ride the k axis), so the quantized cache
+                # feeds the einsum through a fusable cast — never a
+                # materialized dequantized copy (the round-5 measured
+                # pitfall: dequantize-then-einsum was SLOWER than the
+                # bf16 cache, PERF.md §18 addendum).
+                keys, vals = ck.value, cv.value
+                if quant:
+                    keys = keys.astype(q.dtype)
+                    vals = vals.astype(q.dtype)
                 q_pos = idx + jnp.arange(t)
                 k_pos = jnp.arange(self.cache_len)
                 mask = k_pos[None, :] <= q_pos[:, None]     # [t, L]
                 qg = q.reshape(b, t, kvh, group, head_dim)
-                logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, keys) \
+                logits = jnp.einsum("bqhgd,bhkd->bhgqk", qg, keys) \
                     * scale
+                if quant:
+                    # ks: [B, KVH, L, 1] -> broadcast over (g, q)
+                    logits = logits * ks.value[:, :, None, None, :, 0]
                 logits = jnp.where(mask[None, None, None], logits,
                                    -1e30)
                 probs = nn.softmax(logits.astype(jnp.float32),
                                    axis=-1).astype(q.dtype)
-                out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vals)
+                if quant:
+                    probs = (probs.astype(jnp.float32)
+                             * vs.value[:, :, None, None, :, 0]
+                             ).astype(q.dtype)
+                out = jnp.einsum("bhgqk,bhkd->bqhgd", probs, vals)
                 out = out.reshape(b, t, self.num_heads, head_dim)
             out = jnp.where(ok, out, jnp.nan)
         else:

@@ -148,10 +148,16 @@ def time_op(step, args, est_ms, reps=3, target_ms=250.0,
 
 
 def _gate(out):
-    # genuinely value-dependent (≈1.0): `* 0 + 1` would constant-fold,
-    # letting XLA hoist the op out of the scan as loop-invariant —
-    # which is exactly what the first run of this script measured
-    return out.reshape(-1)[0].astype(jnp.float32) * 1e-24 + 1.0
+    # The gate must (a) be genuinely value-dependent — `* 0 + 1` would
+    # constant-fold and let XLA hoist the op out of the scan as
+    # loop-invariant — and (b) depend on EVERY output element: a
+    # single-element gate lets XLA's slice-sinking compute just one
+    # conv window per iteration (the second broken run of this script:
+    # convs "measuring" 100x under their FLOP bound while the
+    # full-tensor GN stats measured true).  The full sum costs one
+    # extra read-pass over the output (~bytes/BW), <10% on the
+    # bandwidth-bound ops and noise on the compute-bound ones.
+    return jnp.sum(out.astype(jnp.float32)) * 1e-24 + 1.0
 
 
 def conv_fwd_step(stride, x, w):
@@ -164,7 +170,11 @@ def conv_fwd_step(stride, x, w):
     return step
 
 
-def conv_train_step(stride, x, w):
+def conv_train_step(stride, x, w, r):
+    # `r` is a RANDOM cotangent: grad of a plain sum hands the
+    # backward an all-ones cotangent, which XLA simplifies into cheap
+    # reductions instead of real dgrad/wgrad convs (the third broken
+    # run of this script: conv "train" rows beating the bf16 peak).
     def loss(x, w):
         # output stays bf16 so the dgrad/wgrad convs run bf16 like the
         # model's (grad of a preferred_element_type=f32 conv would mix
@@ -172,7 +182,7 @@ def conv_train_step(stride, x, w):
         out = lax.conv_general_dilated(
             x, w, (stride, stride), "SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return jnp.sum(out.astype(jnp.float32))
+        return jnp.sum(out.astype(jnp.float32) * r)
 
     def step(s, x, w):
         gx, gw = jax.grad(loss, argnums=(0, 1))(x * s.astype(x.dtype),
@@ -181,7 +191,7 @@ def conv_train_step(stride, x, w):
     return step
 
 
-def gn_steps(c, x, scale, bias):
+def gn_steps(c, x, scale, bias, r):
     import math
 
     groups = math.gcd(32, c)
@@ -201,7 +211,7 @@ def gn_steps(c, x, scale, bias):
 
     def train(s, x, scale, bias):
         g = jax.grad(lambda x: jnp.sum(
-            apply(x).astype(jnp.float32)))(x * s.astype(x.dtype))
+            apply(x).astype(jnp.float32) * r))(x * s.astype(x.dtype))
         return _gate(g)
     return fwd, train
 
@@ -210,13 +220,14 @@ def nn_relu(x):
     return jnp.maximum(x, 0)
 
 
-def add_steps(x, y):
+def add_steps(x, y, r):
     def fwd(s, x, y):
         return _gate(nn_relu(x * s.astype(x.dtype) + y))
 
     def train(s, x, y):
         g = jax.grad(lambda x: jnp.sum(
-            nn_relu(x + y).astype(jnp.float32)))(x * s.astype(x.dtype))
+            nn_relu(x + y).astype(jnp.float32) * r))(
+                x * s.astype(x.dtype))
         return _gate(g)
     return fwd, train
 
@@ -268,6 +279,8 @@ def main():
         x = jax.random.normal(key, (batch, h, h, cin), jnp.bfloat16)
         w = jax.random.normal(key, (k, k, cin, cout),
                               jnp.bfloat16) * 0.05
+        r = jax.random.normal(key, (batch, ho, ho, cout),
+                              jnp.float32)
         flops = 2.0 * batch * ho * ho * cout * k * k * cin
         b_in = x.size * 2
         b_w = w.size * 2
@@ -277,23 +290,24 @@ def main():
         bytes_train = bytes_fwd + (b_out + b_w + b_in) \
             + (b_in + b_out + b_w)
         measure(name, count, conv_fwd_step(stride, x, w),
-                conv_train_step(stride, x, w), (x, w), flops,
+                conv_train_step(stride, x, w, r), (x, w), flops,
                 bytes_fwd, bytes_train)
 
     print("[roofline] norm / elementwise classes", flush=True)
     for name, count, h, c in norm_inventory(image):
         x = jax.random.normal(key, (batch, h, h, c), jnp.bfloat16)
         nbytes = x.size * 2
+        r = jax.random.normal(key, x.shape, jnp.float32)
         if name.startswith("add"):
             y = jax.random.normal(key, x.shape, jnp.bfloat16)
-            fwd, train = add_steps(x, y)
+            fwd, train = add_steps(x, y, r)
             op_args = (x, y)
             bytes_fwd, bytes_train = 3 * nbytes, 3 * nbytes + 2 * nbytes
             flops = x.size * 2.0
         else:
             scale = jnp.ones((c,), jnp.float32)
             bias = jnp.zeros((c,), jnp.float32)
-            fwd, train = gn_steps(c, x, scale, bias)
+            fwd, train = gn_steps(c, x, scale, bias, r)
             op_args = (x, scale, bias)
             # one stats read-pass + one normalize read+write pass
             bytes_fwd = 3 * nbytes
@@ -306,6 +320,8 @@ def main():
     print("[roofline] tail (pool/dense/loss)", flush=True)
     s = image // 2
     xs = jax.random.normal(key, (batch, s, s, 64), jnp.bfloat16)
+    rp = jax.random.normal(key, (batch, s // 2, s // 2, 64),
+                           jnp.float32)
     measure("maxpool 3x3/s2 @stem", 1,
             lambda g, x: _gate(lax.reduce_window(
                 x * g.astype(x.dtype), -jnp.inf, lax.max,
@@ -313,7 +329,7 @@ def main():
             lambda g, x: _gate(jax.grad(lambda x: jnp.sum(
                 lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
                                   (1, 2, 2, 1), "SAME")
-                .astype(jnp.float32)))(x * g.astype(x.dtype))),
+                .astype(jnp.float32) * rp))(x * g.astype(x.dtype))),
             (xs,), xs.size * 9.0, xs.size * 2 * 1.25,
             xs.size * 2 * 2.5)
     xf = jax.random.normal(key, (batch, image // 32, image // 32, 2048),
