@@ -33,6 +33,15 @@ def main():
     parser.add_argument("--new-tokens", type=int, default=24)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--kv-heads", type=int, default=None,
+                        help="GQA: K/V heads (divides 4); shrinks "
+                             "the serving KV cache by the group "
+                             "factor — 5.8-9x measured per-step "
+                             "decode cost (PERF.md §18 addendum)")
+    parser.add_argument("--kv-dtype", default=None,
+                        choices=[None, "int8"],
+                        help="int8-quantized KV cache (+31% measured "
+                             "decode throughput at MHA scale)")
     args = parse_args_and_setup(parser)
     from distkeras_tpu.profiling import profiler_trace
 
@@ -56,7 +65,8 @@ def _run(args):
     cfg = model_config(
         "transformer_lm", (args.seq_len,), input_dtype="int32",
         vocab_size=args.vocab_size, num_layers=2, d_model=64,
-        num_heads=4, max_len=args.seq_len, dtype="float32")
+        num_heads=4, max_len=args.seq_len, dtype="float32",
+        num_kv_heads=args.kv_heads, kv_cache_dtype=args.kv_dtype)
     trainer = SingleTrainer(cfg, loss="sparse_categorical_crossentropy",
                             worker_optimizer="adam",
                             learning_rate=args.learning_rate,
@@ -82,10 +92,13 @@ def _run(args):
     logits = np.asarray(model.apply(variables, greedy)
                         .astype(jnp.float32))
     gen = np.asarray(greedy)
+    # int8 cache: decode logits carry the quantization error bound,
+    # so the teacher-forced gap tolerance widens accordingly
+    tol = 0.05 if args.kv_dtype is None else 0.5
     for i in range(args.prompt_len, gen.shape[1]):
         step = logits[:, i - 1]
         gap = step.max(-1) - step[np.arange(len(gen)), gen[:, i]]
-        assert (gap <= 0.05).all(), (i, gap)
+        assert (gap <= tol).all(), (i, gap)
 
     # beam decoding: report both sequences' teacher-forced log-probs
     # (beam typically scores higher; the guarantee is not strict once

@@ -104,21 +104,27 @@ def norm_inventory(image: int):
 # ---------------------------------------------------------------------
 
 
-def _time(go, args, reps):
+def _time(go, carry0, rest, reps):
     """Best-of-reps wall time of the jitted chain (scalar-fetch sync)."""
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = go(jnp.float32(1.0), *args)
+        out = go(carry0, *rest)
         float(out)  # host fetch = the only reliable sync on this rig
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def time_op(step, args, est_ms, reps=3, target_ms=250.0,
+def time_op(step, carry0, rest, est_ms, reps=3, target_ms=250.0,
             max_iters=4000):
-    """Per-call seconds of ``step(gate, *args) -> new_gate`` via two
+    """Per-call seconds of ``step(carry, *rest) -> carry`` via two
     chain lengths: dispatch/sync overhead cancels in the difference.
+
+    ``carry0`` is the loop-carried operand — a probe scalar for ops
+    that are nonlinear in their input, or the WEIGHTS for convs (see
+    ``conv_fwd_step``).  Only a scalar probe of the final carry is
+    fetched (fetching a full carry through the 11 MB/s tunnel would
+    dwarf the measurement).
 
     The tunnel's dispatch round-trip jitters by tens of ms, so the
     DIFFERENCED work must dominate it: the chain lengths are scaled
@@ -132,18 +138,23 @@ def time_op(step, args, est_ms, reps=3, target_ms=250.0,
 
     def build(n):
         @jax.jit
-        def go(gate, *args):
-            def body(s, _):
-                return step(s, *args), None
-            s, _ = lax.scan(body, gate, None, length=n)
-            return s
+        def go(c0, *rest):
+            def body(c, _):
+                return step(c, *rest), None
+            c, _ = lax.scan(body, c0, None, length=n)
+            # probe element: every iteration's epsilon feeds the
+            # carry multiplicatively, so one element of the final
+            # carry transitively requires the whole chain
+            probe = c if getattr(c, "ndim", 0) == 0 \
+                else c.reshape(-1)[0]
+            return probe.astype(jnp.float32)
         return go
 
     hi, lo = build(n_hi), build(n_lo)
-    float(hi(jnp.float32(1.0), *args))  # compile + warm
-    float(lo(jnp.float32(1.0), *args))
-    t_hi = _time(hi, args, reps)
-    t_lo = _time(lo, args, reps)
+    float(hi(carry0, *rest))  # compile + warm
+    float(lo(carry0, *rest))
+    t_hi = _time(hi, carry0, rest, reps)
+    t_lo = _time(lo, carry0, rest, reps)
     return max(t_hi - t_lo, 1e-9) / (n_hi - n_lo)
 
 
@@ -160,38 +171,51 @@ def _gate(out):
     return jnp.sum(out.astype(jnp.float32)) * 1e-24 + 1.0
 
 
-def conv_fwd_step(stride, x, w):
-    def step(s, x, w):
-        out = lax.conv_general_dilated(
-            x * s.astype(x.dtype), w, (stride, stride), "SAME",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
-        return _gate(out)
-    return step
+# Convolution is BILINEAR, which defeats every scalar-gate scheme:
+# with input x*s the dgrad cotangent path conv_t(r, w) references
+# neither x nor s — structurally loop-invariant, hoisted (the third
+# broken run measured exactly that).  So the conv chains carry the
+# WEIGHTS: wc is perturbed each iteration by an output-derived epsilon
+# (~1e-30, value-neutral but structurally load-bearing), making every
+# conv in both passes depend on the carry.  The train loss is
+# QUADRATIC in the output so the weight-grad's cotangent (2*out*r)
+# also depends on wc.  Extra per-iteration cost: one fused output
+# reduce + a weight-sized update — noise next to the conv itself.
 
 
-def conv_train_step(stride, x, w, r):
-    # `r` is a RANDOM cotangent: grad of a plain sum hands the
-    # backward an all-ones cotangent, which XLA simplifies into cheap
-    # reductions instead of real dgrad/wgrad convs (the third broken
-    # run of this script: conv "train" rows beating the bf16 peak).
-    def loss(x, w):
-        # output stays bf16 so the dgrad/wgrad convs run bf16 like the
-        # model's (grad of a preferred_element_type=f32 conv would mix
-        # f32 cotangents into bf16 convs)
+def conv_fwd_step(stride):
+    def step(wc, x):
         out = lax.conv_general_dilated(
-            x, w, (stride, stride), "SAME",
+            x, wc, (stride, stride), "SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return jnp.sum(out.astype(jnp.float32) * r)
-
-    def step(s, x, w):
-        gx, gw = jax.grad(loss, argnums=(0, 1))(x * s.astype(x.dtype),
-                                                w)
-        return _gate(gx) * _gate(gw)
+        eps = jnp.sum(out.astype(jnp.float32)) * 1e-30
+        return wc * (1.0 + eps).astype(wc.dtype)
     return step
 
 
-def gn_steps(c, x, scale, bias, r):
+def conv_train_step(stride):
+    # `r` is a RANDOM cotangent scaffold (an all-ones cotangent lets
+    # XLA collapse the backward into reductions); it rides as an
+    # ARGUMENT — a closure-captured array becomes an HLO literal,
+    # which the 11 MB/s tunnel would ship per compile (the fourth
+    # broken run: a 1.3 GB stem constant, never finished).
+    def step(wc, x, r):
+        def loss(x, w):
+            # output stays bf16 so the dgrad/wgrad convs run bf16
+            # like the model's
+            out = lax.conv_general_dilated(
+                x, w, (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return jnp.sum(out.astype(jnp.float32) ** 2
+                           * r.astype(jnp.float32))
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, wc)
+        eps = (jnp.sum(gx.astype(jnp.float32))
+               + jnp.sum(gw.astype(jnp.float32))) * 1e-30
+        return wc * (1.0 + eps).astype(wc.dtype)
+    return step
+
+
+def gn_steps(c, x, scale, bias):
     import math
 
     groups = math.gcd(32, c)
@@ -206,12 +230,13 @@ def gn_steps(c, x, scale, bias, r):
         y = ((g - mean) * inv).reshape(b, h, w_, c)
         return nn_relu(y * scale + bias).astype(x.dtype)
 
-    def fwd(s, x, scale, bias):
+    def fwd(s, x, scale, bias, *_):
         return _gate(apply(x * s.astype(x.dtype)))
 
-    def train(s, x, scale, bias):
+    def train(s, x, scale, bias, r):
         g = jax.grad(lambda x: jnp.sum(
-            apply(x).astype(jnp.float32) * r))(x * s.astype(x.dtype))
+            apply(x).astype(jnp.float32)
+            * r.astype(jnp.float32)))(x * s.astype(x.dtype))
         return _gate(g)
     return fwd, train
 
@@ -220,11 +245,11 @@ def nn_relu(x):
     return jnp.maximum(x, 0)
 
 
-def add_steps(x, y, r):
-    def fwd(s, x, y):
+def add_steps():
+    def fwd(s, x, y, *_):
         return _gate(nn_relu(x * s.astype(x.dtype) + y))
 
-    def train(s, x, y):
+    def train(s, x, y, r):
         g = jax.grad(lambda x: jnp.sum(
             nn_relu(x + y).astype(jnp.float32) * r))(
                 x * s.astype(x.dtype))
@@ -253,13 +278,19 @@ def main():
 
     rows = []
 
-    def measure(name, count, step_fwd, step_train, op_args, flops_fwd,
-                bytes_fwd, bytes_train):
+    probe = jnp.float32(1.0)  # scalar carry for the non-conv chains
+
+    def measure(name, count, fwd_spec, train_spec, flops_fwd,
+                bytes_fwd, bytes_train, train_overhead_ms=0.0):
+        (step_fwd, c_fwd, rest_fwd) = fwd_spec
+        (step_train, c_train, rest_train) = train_spec
         est_fwd = max(flops_fwd / PEAK, bytes_fwd / BW) * 1e3
         est_train = max(3 * flops_fwd / PEAK, bytes_train / BW) * 1e3
-        t_fwd = time_op(step_fwd, op_args, est_fwd, reps, target)
-        t_train = time_op(step_train, op_args, est_train, reps,
-                          target)
+        t_fwd = time_op(step_fwd, c_fwd, rest_fwd, est_fwd, reps,
+                        target)
+        t_train = time_op(step_train, c_train, rest_train, est_train,
+                          reps, target)
+        t_train = max(t_train - train_overhead_ms * 1e-3, t_fwd)
         rows.append({
             "name": name, "count": count,
             "fwd_ms": t_fwd * 1e3, "train_ms": t_train * 1e3,
@@ -280,7 +311,7 @@ def main():
         w = jax.random.normal(key, (k, k, cin, cout),
                               jnp.bfloat16) * 0.05
         r = jax.random.normal(key, (batch, ho, ho, cout),
-                              jnp.float32)
+                              jnp.bfloat16)
         flops = 2.0 * batch * ho * ho * cout * k * k * cin
         b_in = x.size * 2
         b_w = w.size * 2
@@ -289,31 +320,38 @@ def main():
         # dgrad: read dout+w, write dx; wgrad: read x+dout, write dw
         bytes_train = bytes_fwd + (b_out + b_w + b_in) \
             + (b_in + b_out + b_w)
-        measure(name, count, conv_fwd_step(stride, x, w),
-                conv_train_step(stride, x, w, r), (x, w), flops,
-                bytes_fwd, bytes_train)
+        # the quadratic-loss scaffold re-reads out and writes dout —
+        # traffic the model's own backward does NOT pay (its dout
+        # arrives as the next op's cotangent, and the r read stands in
+        # for exactly that) — subtract it analytically
+        overhead_ms = 2 * b_out / BW * 1e3
+        measure(name, count,
+                (conv_fwd_step(stride), w, (x,)),
+                (conv_train_step(stride), w, (x, r)), flops,
+                bytes_fwd, bytes_train, train_overhead_ms=overhead_ms)
 
     print("[roofline] norm / elementwise classes", flush=True)
     for name, count, h, c in norm_inventory(image):
         x = jax.random.normal(key, (batch, h, h, c), jnp.bfloat16)
         nbytes = x.size * 2
-        r = jax.random.normal(key, x.shape, jnp.float32)
+        r = jax.random.normal(key, x.shape, jnp.bfloat16)
         if name.startswith("add"):
             y = jax.random.normal(key, x.shape, jnp.bfloat16)
-            fwd, train = add_steps(x, y, r)
-            op_args = (x, y)
+            fwd, train = add_steps()
+            op_args = (x, y, r)
             bytes_fwd, bytes_train = 3 * nbytes, 3 * nbytes + 2 * nbytes
             flops = x.size * 2.0
         else:
             scale = jnp.ones((c,), jnp.float32)
             bias = jnp.zeros((c,), jnp.float32)
-            fwd, train = gn_steps(c, x, scale, bias, r)
-            op_args = (x, scale, bias)
+            fwd, train = gn_steps(c, x, scale, bias)
+            op_args = (x, scale, bias, r)
             # one stats read-pass + one normalize read+write pass
             bytes_fwd = 3 * nbytes
             bytes_train = bytes_fwd + 3 * nbytes
             flops = x.size * 8.0
-        measure(name, count, fwd, train, op_args, flops, bytes_fwd,
+        measure(name, count, (fwd, probe, op_args),
+                (train, probe, op_args), flops, bytes_fwd,
                 bytes_train)
 
     # tail: maxpool, global mean, dense+loss — measured as one class
@@ -321,16 +359,19 @@ def main():
     s = image // 2
     xs = jax.random.normal(key, (batch, s, s, 64), jnp.bfloat16)
     rp = jax.random.normal(key, (batch, s // 2, s // 2, 64),
-                           jnp.float32)
+                           jnp.bfloat16)
+    pool_fwd = lambda g, x, rp: _gate(lax.reduce_window(  # noqa: E731
+        x * g.astype(x.dtype), -jnp.inf, lax.max,
+        (1, 3, 3, 1), (1, 2, 2, 1), "SAME"))
+    pool_train = lambda g, x, rp: _gate(  # noqa: E731
+        jax.grad(lambda x: jnp.sum(
+            lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+            .astype(jnp.float32) * rp))(x * g.astype(x.dtype)))
     measure("maxpool 3x3/s2 @stem", 1,
-            lambda g, x: _gate(lax.reduce_window(
-                x * g.astype(x.dtype), -jnp.inf, lax.max,
-                (1, 3, 3, 1), (1, 2, 2, 1), "SAME")),
-            lambda g, x: _gate(jax.grad(lambda x: jnp.sum(
-                lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
-                                  (1, 2, 2, 1), "SAME")
-                .astype(jnp.float32) * rp))(x * g.astype(x.dtype))),
-            (xs,), xs.size * 9.0, xs.size * 2 * 1.25,
+            (pool_fwd, probe, (xs, rp)),
+            (pool_train, probe, (xs, rp)),
+            xs.size * 9.0, xs.size * 2 * 1.25,
             xs.size * 2 * 2.5)
     xf = jax.random.normal(key, (batch, image // 32, image // 32, 2048),
                            jnp.bfloat16)
@@ -347,7 +388,9 @@ def main():
         gx, gw = jax.grad(loss, (0, 1))(x * g.astype(x.dtype), w)
         return _gate(gx) * _gate(gw)
 
-    measure("meanpool+dense+loss", 1, head_fwd, head_train, (xf, wd),
+    measure("meanpool+dense+loss", 1,
+            (head_fwd, probe, (xf, wd)),
+            (head_train, probe, (xf, wd)),
             2.0 * batch * 2048 * 1000, xf.size * 2 + wd.size * 4,
             (xf.size * 2 + wd.size * 4) * 3)
 
