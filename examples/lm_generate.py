@@ -39,7 +39,7 @@ def main():
                              "factor — 5.8-9x measured per-step "
                              "decode cost (PERF.md §18 addendum)")
     parser.add_argument("--kv-dtype", default=None,
-                        choices=[None, "int8"],
+                        choices=["int8"],
                         help="int8-quantized KV cache (+31% measured "
                              "decode throughput at MHA scale)")
     args = parse_args_and_setup(parser)

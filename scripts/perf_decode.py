@@ -51,7 +51,7 @@ def main():
                          "shrinks the per-token KV-cache read by the "
                          "group factor (PERF.md §18 addendum)")
     ap.add_argument("--kv-dtype", default=None,
-                    choices=[None, "int8"],
+                    choices=["int8"],
                     help="int8: quantized KV cache (halves the bf16 "
                          "cache's per-token HBM traffic)")
     ap.add_argument("--attn", default="auto",

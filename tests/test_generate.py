@@ -132,17 +132,21 @@ def test_scan_blocks_rejected_with_pointer():
                  jnp.zeros((1, 2), jnp.int32), max_new_tokens=1)
 
 
-def test_tensor_parallel_decode_matches_single_device():
+@pytest.mark.parametrize("kv_heads", [None, 2],
+                         ids=["mha", "gqa"])
+def test_tensor_parallel_decode_matches_single_device(kv_heads):
     """TP serving needs no dedicated decode API: shard the params with
     the trainer-side TP rules and jit generate — GSPMD propagates the
-    head shardings into the per-layer KV caches and the scan."""
+    head shardings into the per-layer KV caches and the scan.  GQA
+    composes: the num_kv_heads axis shards like the full head axis,
+    into the smaller caches."""
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 devices")
     from distkeras_tpu import mesh as mesh_lib
     from distkeras_tpu.parallel import tensor_parallel as tp
 
     # TP-friendly dims: heads and vocab must divide model_parallel=2
-    spec, model, variables = _model(vocab=36)
+    spec, model, variables = _model(vocab=36, num_kv_heads=kv_heads)
     prompt = jax.random.randint(jax.random.key(4), (2, 6), 0, 36)
     want = np.asarray(generate(model, variables, prompt,
                                max_new_tokens=5))
@@ -431,25 +435,3 @@ def test_gqa_int8_compose_in_generate():
     both = generate(model.clone(kv_cache_dtype="int8"), variables,
                     prompt, max_new_tokens=5)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(both))
-
-
-def test_gqa_tensor_parallel_decode_matches_single_device():
-    """GQA composes with TP serving: the K/V head axis (num_kv_heads)
-    shards over model_parallel exactly like the full head axis, and
-    GSPMD propagates it into the smaller KV caches."""
-    if len(jax.devices()) < 2:
-        pytest.skip("needs 2 devices")
-    from distkeras_tpu import mesh as mesh_lib
-    from distkeras_tpu.parallel import tensor_parallel as tp
-
-    spec, model, variables = _model(vocab=36, num_kv_heads=2)
-    prompt = jax.random.randint(jax.random.key(15), (2, 6), 0, 36)
-    want = np.asarray(generate(model, variables, prompt,
-                               max_new_tokens=5))
-    mesh = mesh_lib.create_mesh(1, model_parallel=2)
-    shardings = tp.tree_shardings(mesh, variables,
-                                  tp.rules_for("transformer_lm"))
-    v_tp = jax.device_put(variables, shardings)
-    got = np.asarray(jax.jit(lambda v, p: generate(
-        model, v, p, max_new_tokens=5))(v_tp, prompt))
-    np.testing.assert_array_equal(got, want)
