@@ -8,6 +8,8 @@ self-contained: an evaluator consumes a prediction column (from
 
 from __future__ import annotations
 
+import re
+
 from typing import Callable, Mapping
 
 import numpy as np
@@ -297,8 +299,16 @@ def evaluate_model(model, variables: Mapping, dataset: Dataset, *,
             raise ValueError(
                 f"label_col={list(label_col)} names "
                 f"{len(label_col)} heads but the model has 1")
-        n_heads = len([c for c in scored.column_names
-                       if c.startswith("prediction_")])
+        # Count exactly the columns the predictor APPENDS: contiguous
+        # prediction_0..prediction_{n-1}.  A user dataset that already
+        # carries its own prediction_*-named columns (the predictor
+        # keeps input columns) must not inflate the head count
+        # (ADVICE.md r5).
+        numbered = {int(m.group(1)) for c in scored.column_names
+                    if (m := re.fullmatch(r"prediction_(\d+)", c))}
+        n_heads = 0
+        while n_heads in numbered:
+            n_heads += 1
         if n_heads != len(label_col):
             # a head-count mismatch in EITHER direction is loud —
             # silently scoring the first len(label_col) heads would be

@@ -95,6 +95,33 @@ def _select(logits, temperature, top_k, top_p, rng):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def decode_step(dec, params: Mapping, cache, tok, *, slot_pos=None,
+                temperature: float = 0.0, top_k: int | None = None,
+                top_p: float | None = None, rng=None):
+    """One cached T=1 decode step, factored OUT of ``generate``'s
+    ``lax.scan`` so a host scheduler can interleave admissions between
+    steps (``serving.DecodeEngine``'s continuous-batching contract).
+
+    Args:
+      dec: a decode-mode model (``_decode_model`` output or an
+        equivalent ``clone(decode=True)``).
+      params: ``{"params": ...}`` (cache NOT included).
+      cache: the ``"cache"`` collection to advance.
+      tok: ``[B]`` int32 — the token each row feeds this step.
+      slot_pos: optional ``[B]`` int32 per-slot cache positions
+        (continuous batching); None = the scalar-index contract.
+      rng: key for sampling (``temperature > 0``).
+
+    Returns ``(new_cache, next_tok)`` with ``next_tok`` ``[B]`` int32.
+    Jit-compatible; ``generate`` runs exactly this inside its scan.
+    """
+    logits, state = dec.apply({**params, "cache": cache}, tok[:, None],
+                              slot_pos=slot_pos, mutable=["cache"])
+    nxt = _select(logits[:, -1].astype(jnp.float32), temperature,
+                  top_k, top_p, rng)
+    return state["cache"], nxt
+
+
 def generate(model, variables: Mapping, prompt, *,
              max_new_tokens: int, temperature: float = 0.0,
              top_k: int | None = None, top_p: float | None = None,
@@ -178,15 +205,14 @@ def generate(model, variables: Mapping, prompt, *,
 
     def step(carry, _):
         cache, tok, rng, done = carry
-        logits, state = dec.apply({**params, "cache": cache},
-                                  tok[:, None], mutable=["cache"])
         rng, sub = jax.random.split(rng)
-        nxt = _select(logits[:, -1].astype(jnp.float32), temperature,
-                      top_k, top_p, sub)
+        cache, nxt = decode_step(dec, params, cache, tok,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, rng=sub)
         if eos_id is not None:
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)
-        return (state["cache"], nxt, rng, done), tok
+        return (cache, nxt, rng, done), tok
 
     if max_new_tokens > 1:
         (_, last, _, _), toks = lax.scan(

@@ -24,10 +24,29 @@ import jax.numpy as jnp
 
 from distkeras_tpu.models.core import register_model
 from distkeras_tpu.parallel.moe import expert_capacity, routing
+from distkeras_tpu.utils import axis_size
 
 AttnFn = Callable[..., jnp.ndarray]
 
 _ATTN_CHOICES = ("auto", "dense", "blockwise", "flash")
+
+
+def _committed_platform(x) -> Optional[str]:
+    """Platform of the devices ``x`` is committed to, when knowable.
+
+    Eager calls on placed arrays resolve against the ACTUAL placement
+    (ADVICE r5: a CPU-forced debugging run on a TPU host must not pick
+    the Pallas path).  Under ``jit`` the input is a tracer with no
+    committed devices; returns None so callers fall back to the
+    repo-wide ``jax.devices()[0]`` convention — the default backend's
+    first device, which is where an unpinned trace executes."""
+    try:
+        platforms = {d.platform for d in x.devices()}
+        if len(platforms) == 1:
+            return platforms.pop()
+    except Exception:
+        pass
+    return None
 
 
 def dense_causal_attention(q, k, v, *, scale):
@@ -76,6 +95,14 @@ class SelfAttention(nn.Module):
     ``kv_cache_dtype="int8"`` stores the cache quantized (symmetric
     per-position-per-head scales in f32) — halving the bf16 cache's
     HBM traffic — and dequantizes on read.
+
+    ``slot_pos`` (call-time, ``[B]`` int32) switches the T=1 step to
+    SLOT mode for continuous-batching serving (``serving.DecodeEngine``):
+    each batch row is an independent request at its OWN cache position,
+    so the K/V write is a per-row scatter at ``slot_pos[b]`` and the
+    causal mask is per-row (``k <= slot_pos[b]``).  The scalar
+    ``cache_index`` is left untouched — slot state lives with the
+    engine, which admits/evicts rows between steps.
     """
 
     num_heads: int
@@ -86,7 +113,7 @@ class SelfAttention(nn.Module):
     kv_cache_dtype: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slot_pos=None):
         import jax.lax as lax
 
         d_model = x.shape[-1]
@@ -124,6 +151,26 @@ class SelfAttention(nn.Module):
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
+            if slot_pos is not None and t != 1:
+                raise ValueError(
+                    "slot_pos is the continuous-batching T=1 step "
+                    f"contract (per-row cache positions); got a T={t} "
+                    "chunk — prefill a slot through the scalar-index "
+                    "path instead")
+            rows = jnp.arange(b)
+
+            def write(cache, chunk):
+                # chunk: [B, T, ...] -> cache [B, KVH, L, ...]
+                chunk = jnp.swapaxes(chunk, 1, 2)
+                if slot_pos is not None:
+                    # per-row scatter: row b writes its single token at
+                    # its OWN position (OOB positions drop the update;
+                    # the ok-poison below keeps that loud)
+                    return cache.at[rows, :, slot_pos, :].set(
+                        chunk[:, :, 0])
+                return lax.dynamic_update_slice(cache, chunk,
+                                                (0, 0, idx, 0))
+
             if quant:
                 sshape = (b, kvh, self.cache_len, 1)
                 ks = self.variable("cache", "key_scale", jnp.zeros,
@@ -132,23 +179,22 @@ class SelfAttention(nn.Module):
                                    sshape, jnp.float32)
                 k_w, k_s = _quantize_kv(k)
                 v_w, v_s = _quantize_kv(v)
-                ks.value = lax.dynamic_update_slice(
-                    ks.value, jnp.swapaxes(k_s, 1, 2), (0, 0, idx, 0))
-                vs.value = lax.dynamic_update_slice(
-                    vs.value, jnp.swapaxes(v_s, 1, 2), (0, 0, idx, 0))
+                ks.value = write(ks.value, k_s)
+                vs.value = write(vs.value, v_s)
             else:
                 k_w, v_w = k, v
-            ck.value = lax.dynamic_update_slice(
-                ck.value, jnp.swapaxes(k_w, 1, 2), (0, 0, idx, 0))
-            cv.value = lax.dynamic_update_slice(
-                cv.value, jnp.swapaxes(v_w, 1, 2), (0, 0, idx, 0))
-            ci.value = idx + t
+            ck.value = write(ck.value, k_w)
+            cv.value = write(cv.value, v_w)
             # Overflow is a traced condition (cache_index is dynamic),
             # so it cannot raise; dynamic_update_slice would silently
             # CLAMP the write and corrupt the cache.  Poison the
             # output with NaN instead — loud under jit, and it
             # propagates to any downstream logit/metric.
-            ok = idx + t <= self.cache_len
+            if slot_pos is not None:
+                ok = slot_pos + t <= self.cache_len        # [B]
+            else:
+                ci.value = idx + t
+                ok = idx + t <= self.cache_len
             if t > 1 and self.attn_fn is not None:
                 # Prefill through the block-attention kernel: causal
                 # attention WITHIN the chunk, on the raw (pre-
@@ -180,16 +226,20 @@ class SelfAttention(nn.Module):
                 if quant:
                     keys = keys.astype(q.dtype)
                     vals = vals.astype(q.dtype)
-                q_pos = idx + jnp.arange(t)
+                if slot_pos is not None:
+                    q_pos = slot_pos[:, None]               # [B, 1]
+                else:
+                    q_pos = (idx + jnp.arange(t))[None, :]  # [1, t]
                 k_pos = jnp.arange(self.cache_len)
-                mask = k_pos[None, :] <= q_pos[:, None]     # [t, L]
+                # [B|1, t, L]: per-row causal horizon in slot mode
+                mask = k_pos[None, None, :] <= q_pos[:, :, None]
                 qg = q.reshape(b, t, kvh, group, head_dim)
                 logits = jnp.einsum("bqhgd,bhkd->bhgqk", qg, keys) \
                     * scale
                 if quant:
                     # ks: [B, KVH, L, 1] -> broadcast over (g, q)
                     logits = logits * ks.value[:, :, None, None, :, 0]
-                logits = jnp.where(mask[None, None, None], logits,
+                logits = jnp.where(mask[:, None, None], logits,
                                    -1e30)
                 probs = nn.softmax(logits.astype(jnp.float32),
                                    axis=-1).astype(q.dtype)
@@ -199,6 +249,8 @@ class SelfAttention(nn.Module):
                              ).astype(q.dtype)
                 out = jnp.einsum("bhgqk,bhkd->bqhgd", probs, vals)
                 out = out.reshape(b, t, self.num_heads, head_dim)
+            if jnp.ndim(ok):          # slot mode: per-row poison only
+                ok = ok[:, None, None, None]
             out = jnp.where(ok, out, jnp.nan)
         else:
             attn = self.attn_fn or dense_causal_attention
@@ -282,13 +334,14 @@ class Block(nn.Module):
     kv_cache_dtype: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slot_pos=None):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + SelfAttention(self.num_heads, self.dtype, self.attn_fn,
                               cache_len=self.cache_len,
                               num_kv_heads=self.num_kv_heads,
-                              kv_cache_dtype=self.kv_cache_dtype)(y)
+                              kv_cache_dtype=self.kv_cache_dtype)(
+                                  y, slot_pos)
         y = nn.LayerNorm(dtype=self.dtype)(x)
         if self.num_experts > 0:
             y = MoEFFN(self.num_experts, self.mlp_ratio, self.dtype,
@@ -411,7 +464,9 @@ class TransformerLM(nn.Module):
     #: Apply with ``mutable=["cache"]`` and thread the returned cache.
     #: Returns logits for the LAST input position only ([B, 1, V]) —
     #: the one generation consumes; full-vocab f32 logits over a whole
-    #: prompt would dominate prefill activations for nothing.  Same
+    #: prompt would dominate prefill activations for nothing (pass
+    #: ``last_index`` to select a different single position — the
+    #: right-padded-prompt contract of ``serving.DecodeEngine``).  Same
     #: parameters as the training-mode model (``decode`` changes
     #: execution, not the param tree).  The attention spelling
     #: (attn/flash_attn/blockwise_attn/attn_fn) selects the PREFILL
@@ -422,12 +477,31 @@ class TransformerLM(nn.Module):
     #: steps always use the cached dense row.  Incompatible with
     #: seq_axis / scan_blocks.
     decode: bool = False
+    #: size of the per-layer KV cache in decode mode (default:
+    #: ``max_len``).  PERF.md §18 proved every T=1 step pays for the
+    #: STATIC cache envelope, not the live prefix — so a serving slot
+    #: pool whose requests fit 512 positions should carry a 512-slot
+    #: cache even when the model's position table (``max_len``) is
+    #: 2048.  Must be <= max_len (positions are still embedded from
+    #: the full table, so the params are unchanged).  Decode-only.
+    cache_envelope: Optional[int] = None
 
-    def _local_attn_fn(self, t: int) -> Optional[AttnFn]:
+    def _local_attn_fn(self, t: int,
+                       platform: Optional[str] = None) -> Optional[AttnFn]:
         """Resolve the device-local attention spelling for sequence
         length ``t`` (None = dense).  Precedence: attn_fn > the
         boolean spellings > ``attn`` (whose "auto" applies the
-        measured PERF.md §17 recipe)."""
+        measured PERF.md §17 recipe).
+
+        ``platform`` is where the computation runs — taken from the
+        devices the input is committed to when that is knowable
+        (eager calls on placed arrays), else the repo-wide
+        ``jax.devices()[0]`` convention: under ``jit`` the input is a
+        tracer with no committed devices, and the default backend's
+        first device is where an unpinned trace executes.  A
+        CPU-forced debugging run on a TPU host therefore resolves
+        "auto" against CPU when the arrays are committed there; pin
+        ``attn=`` explicitly to override either way."""
         if self.attn_fn is not None:
             return self.attn_fn
         spelling = self.attn
@@ -441,7 +515,9 @@ class TransformerLM(nn.Module):
             # 128-aligned T (Mosaic tiling / chunk divisibility)
             if t < 1024 or t % 128:
                 return None
-            if t >= 2048 and jax.devices()[0].platform == "tpu":
+            if platform is None:
+                platform = jax.devices()[0].platform
+            if t >= 2048 and platform == "tpu":
                 spelling = "flash"
             else:
                 spelling = "blockwise"
@@ -457,12 +533,14 @@ class TransformerLM(nn.Module):
         return blockwise_attn_fn(q_chunk=self.attn_q_chunk or 128)
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, *,
+                 slot_pos=None, last_index=None):
         import jax.lax as lax
 
         dtype = jnp.dtype(self.dtype)
         tokens = tokens.astype(jnp.int32)
         t = tokens.shape[1]
+        platform = _committed_platform(tokens)
         if self.attn not in _ATTN_CHOICES:
             raise ValueError(
                 f"attn={self.attn!r} not one of {_ATTN_CHOICES}")
@@ -487,11 +565,32 @@ class TransformerLM(nn.Module):
                     "trained with (different tokens overflow and "
                     "drop) — serve MoE via the dense full-forward "
                     "path (predictors) instead")
-            if t > self.max_len:
+            cache_len = self.cache_envelope or self.max_len
+            if not 0 < cache_len <= self.max_len:
+                raise ValueError(
+                    f"cache_envelope={self.cache_envelope} outside "
+                    f"(0, max_len={self.max_len}]: the envelope is a "
+                    "slot-pool cache SIZE; positions still embed from "
+                    "the max_len table")
+            if t > cache_len:
                 raise ValueError(
                     f"decode chunk length {t} exceeds the cache size "
-                    f"max_len={self.max_len}")
-            cache_len = self.max_len
+                    f"{cache_len}")
+        if self.cache_envelope is not None and not self.decode:
+            raise ValueError(
+                "cache_envelope sizes the decode-mode KV cache; it "
+                "has no meaning without decode=True")
+        if (slot_pos is not None or last_index is not None) \
+                and not self.decode:
+            raise ValueError(
+                "slot_pos/last_index are decode-mode serving "
+                "contracts (per-slot cache positions / right-padded "
+                "prompt logit row); set decode=True")
+        if slot_pos is not None and t != 1:
+            raise ValueError(
+                "slot_pos advances every live slot by ONE token; got "
+                f"a T={t} chunk — prefill new slots through the "
+                "scalar-index path (serving.DecodeEngine does)")
         if self.blockwise_attn and self.flash_attn:
             raise ValueError(
                 "blockwise_attn and flash_attn are mutually exclusive "
@@ -508,7 +607,7 @@ class TransformerLM(nn.Module):
         if self.seq_axis is not None:
             from distkeras_tpu.parallel.ring_attention import ring_attn_fn
 
-            t_global = t * lax.axis_size(self.seq_axis)
+            t_global = t * axis_size(self.seq_axis)
             positions = (lax.axis_index(self.seq_axis) * t
                          + jnp.arange(t))[None, :]
             if attn_fn is None:
@@ -518,8 +617,14 @@ class TransformerLM(nn.Module):
             t_global = t  # chunk length; prefix bound checked above
             pos_var = self.variable("cache", "pos_index",
                                     lambda: jnp.zeros((), jnp.int32))
-            positions = (pos_var.value + jnp.arange(t))[None, :]
-            pos_var.value = pos_var.value + t
+            if slot_pos is not None:
+                # continuous batching: each slot is at its OWN
+                # position; the engine owns slot state, so the scalar
+                # pos_index is left untouched
+                positions = slot_pos[:, None]
+            else:
+                positions = (pos_var.value + jnp.arange(t))[None, :]
+                pos_var.value = pos_var.value + t
             # multi-token chunks (prefill) run the resolved kernel
             # inside SelfAttention; T=1 steps use the cached row.
             # Serving prompts have ARBITRARY lengths and the blocked
@@ -530,14 +635,14 @@ class TransformerLM(nn.Module):
             # attn_fn is honored as given (the caller owns its
             # shape contract; generate() clears it).
             if t > 1 and (self.attn_fn is not None or t % 128 == 0):
-                attn_fn = self._local_attn_fn(t)
+                attn_fn = self._local_attn_fn(t, platform)
             else:
                 attn_fn = None
         else:
             t_global = t
             positions = jnp.arange(t)[None, :]
             if not self.scan_blocks:
-                attn_fn = self._local_attn_fn(t)
+                attn_fn = self._local_attn_fn(t, platform)
         if t_global > self.max_len:
             raise ValueError(
                 f"sequence length {t_global} exceeds "
@@ -578,13 +683,19 @@ class TransformerLM(nn.Module):
                               cache_len=cache_len,
                               num_kv_heads=self.num_kv_heads,
                               kv_cache_dtype=self.kv_cache_dtype,
-                              name=f"Block_{i}")(x)
+                              name=f"Block_{i}")(x, slot_pos)
         if self.decode:
             # serving returns next-token logits only: the f32
             # full-vocab lm_head over every prompt position would be
             # the prefill's dominant activation for nothing (only the
-            # last row seeds generation)
-            x = x[:, -1:]
+            # last row seeds generation).  last_index selects a
+            # different single row — the right-padded-prompt prefill
+            # contract (pad rows trail the real last token, so -1
+            # would read a pad position's logits).
+            if last_index is not None:
+                x = lax.dynamic_slice_in_dim(x, last_index, 1, 1)
+            else:
+                x = x[:, -1:]
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)

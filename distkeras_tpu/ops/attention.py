@@ -51,7 +51,10 @@ _SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
 
 
 def _params(semantics=_SEMANTICS):
-    return pltpu.CompilerParams(dimension_semantics=semantics)
+    # newer pallas renamed TPUCompilerParams -> CompilerParams
+    cp = getattr(pltpu, "CompilerParams",
+                 getattr(pltpu, "TPUCompilerParams", None))
+    return cp(dimension_semantics=semantics)
 
 
 def _causal_mask(i, j, bq, bk):
@@ -537,11 +540,16 @@ def _hop_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref,
 def _struct(vma, shape):
     """f32 ShapeDtypeStruct, tagged varying-over-``vma`` mesh axes
     when given (required for pallas outputs under shard_map's
-    check_vma)."""
+    check_vma).  Older jax has no vma type system (its ShapeDtypeStruct
+    rejects the kwarg) — the tag only exists for the checker, so it is
+    simply dropped there."""
     if vma is None:
         return jax.ShapeDtypeStruct(shape, jnp.float32)
-    return jax.ShapeDtypeStruct(shape, jnp.float32,
-                                vma=frozenset(vma))
+    try:
+        return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                    vma=frozenset(vma))
+    except TypeError:  # old jax: no vma kwarg (and no checker)
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
 
 
 def _scalar_spec():

@@ -212,10 +212,19 @@ def flush_pending(rule: UpdateRule, ps_state: PSState, pending: Pytree,
                   pending_perm: jnp.ndarray, num_workers: int
                   ) -> PSState:
     """Apply the final round's still-pending commits (the pipelined
-    round always runs one commit behind)."""
+    round always runs one commit behind).
+
+    At the drain no younger window intervenes, so the pending commits
+    land at their TRUE depth — position in the commit order only,
+    ``staleness_offset=0`` — unlike mid-training rounds, whose +W
+    offset reflects the window that ran ahead of them (ADVICE.md r5:
+    the uniform +W at the drain under-weighted DynSGD's last round).
+    ``num_workers`` is kept in the signature for callers that partial
+    it in alongside the round fn."""
+    del num_workers  # true depth at the drain: no window ran ahead
     ordered = _take(pending, pending_perm)
     ps_state, _ = apply_commit_round_pulls(
-        rule, ps_state, ordered, None, staleness_offset=num_workers)
+        rule, ps_state, ordered, None, staleness_offset=0)
     return ps_state
 
 

@@ -121,6 +121,19 @@ class StreamingGenerator:
     A prompt that cannot fit (``len + max_new_tokens > max_len``)
     raises at CONSUME time, naming the row — not later inside a jitted
     flush where already-buffered neighbors would be lost with it.
+
+    ``engine="continuous"`` swaps the run-to-completion bucket flushes
+    for a ``serving.DecodeEngine``: a persistent slot-pool KV cache
+    where an ``eos``/limit-finished row is evicted and replaced
+    between steps instead of draining with its batch (PERF.md §23 —
+    the measured mixed-traffic win).  Same row contract and in-order
+    delivery; outputs are still fixed ``max_new_tokens`` arrays
+    (``pad_id`` after ``eos_id``), and greedy results are identical to
+    the bucketed mode.  ``engine_options`` passes through
+    ``DecodeEngine`` knobs (``buckets``, ``steps_per_sync``,
+    ``prefill_align``, ``slots``...); ``num_beams > 1`` stays
+    bucketed-only.  ``flush_every`` is ignored: admission is
+    per-request, so no bucket can starve a minority length.
     """
 
     def __init__(self, model, variables: Mapping, *,
@@ -131,7 +144,9 @@ class StreamingGenerator:
                  seed: int = 0, prompt_col: str = "prompt",
                  output_col: str = "generated",
                  eos_id: int | None = None, pad_id: int = 0,
-                 flush_every: int | None = None):
+                 flush_every: int | None = None,
+                 engine: str = "bucketed",
+                 engine_options: Mapping | None = None):
         import jax
 
         from distkeras_tpu.models.generate import (_decode_model,
@@ -167,7 +182,22 @@ class StreamingGenerator:
         self.seed = int(seed)
         self.prompt_col = prompt_col
         self.output_col = output_col
+        self.pad_id = int(pad_id)
         self.flush_every = flush_every
+        if engine not in ("bucketed", "continuous"):
+            raise ValueError(
+                f"engine={engine!r} not one of ('bucketed', "
+                "'continuous')")
+        if engine == "continuous" and num_beams > 1:
+            raise ValueError(
+                "engine='continuous' serves single-sequence decoding; "
+                "num_beams > 1 needs the bucketed run-to-completion "
+                "path")
+        self.engine = engine
+        self.engine_options = dict(engine_options or {})
+        self._model = model      # decode-mode clone; the engine's model
+        self._eos_id = eos_id
+        self._engine = None      # built lazily on first stream
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1; got {num_beams}")
         if num_beams > model.vocab_size:
@@ -225,8 +255,66 @@ class StreamingGenerator:
         return {i: {**row, self.output_col: full[j, t_p:]}
                 for j, (i, row) in enumerate(items)}
 
+    def _ensure_engine(self):
+        if self._engine is None:
+            from distkeras_tpu.serving import DecodeEngine
+
+            opts = dict(self.engine_options)
+            opts.setdefault("slots", self.batch_size)
+            self._engine = DecodeEngine(
+                self._model, self.variables,
+                max_new_tokens=self.max_new_tokens,
+                eos_id=self._eos_id, pad_id=self.pad_id,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, seed=self.seed, **opts)
+        return self._engine
+
+    def _continuous_stream(self, rows: Iterable[Mapping[str, Any]]
+                           ) -> Iterator[Mapping[str, Any]]:
+        eng = self._ensure_engine()
+        eng.reset_rng()  # replaying a stream reproduces its draws
+        done: dict[int, Mapping] = {}
+        next_emit = 0
+        rows_by_id: dict[int, Mapping] = {}
+
+        def pad_out(res):
+            row = rows_by_id.pop(res["request_id"])
+            out = np.full((self.max_new_tokens,), self.pad_id,
+                          np.int32)
+            out[:len(res["tokens"])] = res["tokens"]
+            return {**row, self.output_col: out}
+
+        for i, row in enumerate(rows):
+            prompt = np.asarray(row[self.prompt_col])
+            if prompt.ndim != 1:
+                raise ValueError(
+                    f"stream row {i}: prompt must be a 1-D token-id "
+                    f"array; got shape {prompt.shape}")
+            try:
+                eng.submit(prompt, request_id=i)
+            except ValueError as e:
+                raise ValueError(f"stream row {i}: {e}") from e
+            rows_by_id[i] = row
+            # step while the slot pools are saturated (a queue is only
+            # non-empty when every fitting slot is occupied)
+            while any(p.queue for p in eng._pools):
+                for res in eng.step():
+                    done[res["request_id"]] = pad_out(res)
+            while next_emit in done:       # restore input order
+                yield done.pop(next_emit)
+                next_emit += 1
+        while eng.has_work():
+            for res in eng.step():
+                done[res["request_id"]] = pad_out(res)
+        while next_emit in done:
+            yield done.pop(next_emit)
+            next_emit += 1
+
     def generate_stream(self, rows: Iterable[Mapping[str, Any]]
                         ) -> Iterator[Mapping[str, Any]]:
+        if self.engine == "continuous":
+            yield from self._continuous_stream(rows)
+            return
         buckets: dict[int, list] = {}      # prompt_len -> [(i, row)]
         done: dict[int, Mapping] = {}      # row_index -> result
         next_emit = 0

@@ -19,6 +19,17 @@ import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
+SCRIPTS = REPO / "scripts"
+
+# perf/measurement scripts that advertise a --smoke mode run it here
+# at tiny CPU shapes — the same no-silent-rot contract as CASES.
+SMOKE_SCRIPTS = {
+    "perf_roofline.py": ["--smoke"],
+    "perf_serving.py": ["--smoke"],
+}
+# registered but out of tier-1: the roofline smoke sweeps many op
+# shapes and runs minutes-long on the CI CPU (run with -m slow)
+SLOW_SMOKE = {"perf_roofline.py"}
 
 # script -> tiny-shape args (every script also gets --devices 4).
 # Sizes respect each script's internal assertions: convergence checks
@@ -54,6 +65,33 @@ def test_every_example_is_covered():
     assert scripts == set(CASES), (
         f"examples/ and CASES disagree: "
         f"missing={scripts - set(CASES)} stale={set(CASES) - scripts}")
+
+
+def test_every_smoke_script_is_covered():
+    """A scripts/*.py that grows a --smoke mode must be registered in
+    SMOKE_SCRIPTS (or this fails loudly) — same contract as CASES."""
+    smoke = {p.name for p in SCRIPTS.glob("*.py")
+             if "--smoke" in p.read_text()}
+    assert smoke == set(SMOKE_SCRIPTS), (
+        f"scripts/ with --smoke and SMOKE_SCRIPTS disagree: "
+        f"missing={smoke - set(SMOKE_SCRIPTS)} "
+        f"stale={set(SMOKE_SCRIPTS) - smoke}")
+
+
+@pytest.mark.parametrize("script", [
+    pytest.param(s, marks=([pytest.mark.slow] if s in SLOW_SMOKE
+                           else []))
+    for s in sorted(SMOKE_SCRIPTS)])
+def test_smoke_script_runs(script):
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPTS / script), *SMOKE_SCRIPTS[script]],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=str(REPO))
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout[-4000:]}\n"
+        f"--- stderr ---\n{proc.stderr[-4000:]}")
 
 
 @pytest.mark.parametrize("script", sorted(CASES))

@@ -163,3 +163,53 @@ def test_tp_predictor_validation(devices):
 
         ModelPredictor(spec, variables,
                        tp_rules=tp.rules_for("transformer_lm"))
+
+
+def test_evaluate_model_ignores_user_prediction_columns():
+    """ADVICE r5: head counting matches exactly the contiguous
+    prediction_0..n-1 columns the predictor appends — a user dataset
+    that already carries its own prediction_*-named columns (inputs
+    are kept in the scored frame) must not miscount heads."""
+    import json
+
+    import jax
+
+    from distkeras_tpu.compat import from_keras_json
+    from distkeras_tpu.data import Dataset
+
+    arch = {"class_name": "Model", "config": {"name": "m", "layers": [
+        {"name": "in0", "class_name": "InputLayer",
+         "config": {"batch_input_shape": [None, 6]},
+         "inbound_nodes": []},
+        {"name": "a", "class_name": "Dense", "config": {"units": 3},
+         "inbound_nodes": [[["in0", 0, 0, {}]]]},
+        {"name": "b", "class_name": "Dense", "config": {"units": 2},
+         "inbound_nodes": [[["in0", 0, 0, {}]]]},
+    ], "input_layers": [["in0", 0, 0]],
+       "output_layers": [["a", 0, 0], ["b", 0, 0]]}}
+    spec, _ = from_keras_json(json.dumps(arch))
+    variables = spec.build().init(jax.random.key(3),
+                                  np.zeros((2, 6), np.float32))
+    rng = np.random.default_rng(21)
+    cols = {
+        "features": rng.normal(size=(32, 6)).astype(np.float32),
+        "label_a": rng.integers(0, 3, size=32),
+        "label_b": rng.integers(0, 2, size=32),
+        # user columns that USED to inflate the startswith count:
+        "prediction_note": np.zeros(32, np.int32),
+        "prediction_raw": np.zeros(32, np.int32),
+        # non-contiguous numbered column is not predictor output either
+        "prediction_7": np.zeros(32, np.int32),
+    }
+    got = evaluate_model(spec, variables, Dataset(cols),
+                         label_col=["label_a", "label_b"])
+    assert set(got) == {"label_a", "label_b"}
+    clean = {k: v for k, v in cols.items()
+             if not k.startswith("prediction")}
+    want = evaluate_model(spec, variables, Dataset(clean),
+                          label_col=["label_a", "label_b"])
+    assert got == want
+    # the genuine head-count mismatch is still loud
+    with pytest.raises(ValueError, match="heads"):
+        evaluate_model(spec, variables, Dataset(cols),
+                       label_col=["label_a"])

@@ -17,6 +17,7 @@ from distkeras_tpu.parallel.ring_attention import (
     ring_attention,
     sequence_sharded_apply,
 )
+from distkeras_tpu.utils import shard_map
 
 SEQ = "seq"
 
@@ -46,7 +47,7 @@ def test_ring_matches_dense(causal, q_chunk):
     mesh = _mesh()
     q, k, v = _qkv()
     scale = q.shape[-1] ** -0.5
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name=SEQ, causal=causal,
                           q_chunk=q_chunk),
         mesh=mesh, in_specs=(P(None, SEQ), P(None, SEQ), P(None, SEQ)),
@@ -61,7 +62,7 @@ def test_ring_matches_dense(causal, q_chunk):
 def test_indivisible_q_chunk_raises():
     mesh = _mesh()
     q, k, v = _qkv()  # t_local = 8 per device
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name=SEQ, q_chunk=3),
         mesh=mesh, in_specs=(P(None, SEQ), P(None, SEQ), P(None, SEQ)),
         out_specs=P(None, SEQ))
@@ -76,7 +77,7 @@ def test_ring_gradients_match_dense(q_chunk):
     probe = jax.random.normal(jax.random.key(9), q.shape)
 
     def ring_loss(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             functools.partial(ring_attention, axis_name=SEQ,
                               q_chunk=q_chunk),
             mesh=mesh,
@@ -143,7 +144,7 @@ def test_sequence_parallel_training_grads_match_dense():
             local = loss_fn(logits, tgt).mean()
             return jax.lax.pmean(local, SEQ)
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             shard_loss, mesh=mesh,
             in_specs=(P(), P(None, SEQ), P(None, SEQ)),
             out_specs=P())
@@ -261,7 +262,7 @@ def test_flash_impl_ring_matches_dense(causal):
     mesh = _mesh()
     q, k, v = _qkv()
     scale = q.shape[-1] ** -0.5
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name=SEQ, causal=causal,
                           impl="flash", block_q=8, block_k=8),
         mesh=mesh, in_specs=(P(None, SEQ),) * 3,
@@ -278,7 +279,7 @@ def test_flash_impl_ring_gradients_match_dense():
     q, k, v = _qkv()
     scale = q.shape[-1] ** -0.5
     probe = jax.random.normal(jax.random.key(21), q.shape, jnp.float32)
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name=SEQ, causal=True,
                           impl="flash", block_q=8, block_k=8),
         mesh=mesh, in_specs=(P(None, SEQ),) * 3,

@@ -134,3 +134,27 @@ def test_commit_round_is_jittable():
     final, _, _ = jitted(st0, payloads)
     # center after 4 elastic commits of x=1 from c=0: 1-(1-a)^4 = 0.9375
     np.testing.assert_allclose(_leaf(final.center), 0.9375, rtol=1e-6)
+
+
+def test_flush_pending_applies_true_commit_depth():
+    """ADVICE r5: the drain applies the final pending commits at their
+    TRUE depth — staleness = position in the commit order only (no
+    window runs ahead at the drain), so DynSGD scales commit i by
+    1/(i+1), not 1/(i+1+W)."""
+    from distkeras_tpu.parallel.ps_emulator import flush_pending
+
+    rule = DynSGDRule()
+    st0 = rule.init_state(_params(0.0))
+    n = 4
+    payloads = {
+        "w": jnp.stack([jnp.full((3,), float(i + 1)) for i in range(n)]),
+        "b": jnp.stack([jnp.full((2, 2), float(i + 1))
+                        for i in range(n)]),
+    }
+    perm = jnp.arange(n)  # identity commit order
+    final = flush_pending(rule, st0, payloads, perm, n)
+    # center = sum_i payload_i / (i + 1) = 1/1 + 2/2 + 3/3 + 4/4 = 4
+    np.testing.assert_allclose(_leaf(final.center), 4.0, rtol=1e-6)
+    # the old uniform +W drain would have produced sum_i (i+1)/(i+1+W)
+    stale = sum((i + 1.0) / (i + 1.0 + n) for i in range(n))
+    assert not np.allclose(_leaf(final.center), stale)
