@@ -22,11 +22,13 @@ tracks useful work.  Both numbers are reported.
 from __future__ import annotations
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.profiling import (
     peak_flops,
     resnet50_model_flops,
@@ -38,6 +40,10 @@ def main():
     from distkeras_tpu.models import ResNet50
     from distkeras_tpu.workers import (TrainState, make_train_step,
                                        resolve_optimizer)
+
+    trace_path = os.environ.get("DKT_TELEMETRY_TRACE")
+    if trace_path:
+        telemetry.enable()
 
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
@@ -61,12 +67,16 @@ def main():
     batch_dict = {"features": x, "label": labels}
 
     jit_step = jax.jit(step, donate_argnums=0)
-    compiled = jit_step.lower(state, batch_dict).compile()
+    # telemetry consumer wiring: spans are no-ops unless the caller
+    # enabled telemetry (DKT_TELEMETRY_TRACE dumps the timeline)
+    with telemetry.span("bench_compile", batch=batch):
+        compiled = jit_step.lower(state, batch_dict).compile()
     cost = compiled.cost_analysis()
     xla_flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
 
-    dt, synced = time_step_chain(jit_step, state, batch_dict,
-                                 n=30 if on_tpu else 3)
+    with telemetry.span("bench_timed_chain", n=30 if on_tpu else 3):
+        dt, synced = time_step_chain(jit_step, state, batch_dict,
+                                     n=30 if on_tpu else 3)
 
     images_per_sec = batch / dt
     model_flops_per_step = resnet50_model_flops(batch, image)
@@ -89,6 +99,8 @@ def main():
         "peak_flops_known": peak_known,
         "metrics_finite": bool(np.isfinite(synced)),
     }))
+    if trace_path:
+        telemetry.tracer().write_chrome_trace(trace_path)
 
 
 if __name__ == "__main__":

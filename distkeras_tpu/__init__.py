@@ -14,6 +14,7 @@ from distkeras_tpu import (  # noqa: F401
     models,
     ops,
     parallel,
+    telemetry,
 )
 from distkeras_tpu.trainers import (  # noqa: F401
     ADAG,

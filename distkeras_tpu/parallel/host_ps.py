@@ -32,12 +32,12 @@ from __future__ import annotations
 
 import socket
 import threading
-import time
 from typing import Any
 
 import jax
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 
@@ -118,9 +118,10 @@ class HostParameterServer:
     # -- the two verbs -----------------------------------------------------
 
     def pull(self, worker_id: int) -> Pytree:
+        telemetry.metrics().counter("ps_pulls_total").inc()
         with self._lock:
             self._pull_clock[worker_id] = self._clock
-            self._last_seen[worker_id] = time.monotonic()
+            self._last_seen[worker_id] = telemetry.now()
             return self._center
 
     def commit(self, worker_id: int, payload: Pytree,
@@ -148,11 +149,15 @@ class HostParameterServer:
         payload = _to_numpy(payload)
         if local is not None:
             local = _to_numpy(local)
-        with self._lock:
+        m = telemetry.metrics()
+        # the span encloses the mutex wait, so its duration shows both
+        # apply cost and serialization contention on the timeline
+        with telemetry.span("ps_commit", worker=worker_id), self._lock:
             if seq is not None:
                 last = self._last_reply.get(worker_id)
                 if last is not None and seq <= last[0]:
-                    self._last_seen[worker_id] = time.monotonic()
+                    self._last_seen[worker_id] = telemetry.now()
+                    m.counter("ps_commit_dedup_total").inc()
                     return last[1]
             staleness = self._clock - self._pull_clock.get(worker_id, 0)
             state = PSState(center=self._center,
@@ -166,7 +171,11 @@ class HostParameterServer:
             self._pull_clock[worker_id] = self._clock
             self.staleness_log.append(int(staleness))
             self.num_commits += 1
-            self._last_seen[worker_id] = time.monotonic()
+            self._last_seen[worker_id] = telemetry.now()
+            m.counter("ps_commits_total").inc()
+            m.histogram("ps_commit_staleness",
+                        buckets=telemetry.STALENESS_BUCKETS
+                        ).observe(int(staleness))
             pulled = _to_numpy(pulled)
             if seq is not None:
                 self._last_reply[worker_id] = (seq, pulled)
@@ -182,7 +191,7 @@ class HostParameterServer:
         that hangs before ever reaching the server is still flagged by
         ``idle_workers`` instead of being invisible."""
         with self._lock:
-            self._last_seen.setdefault(worker_id, time.monotonic())
+            self._last_seen.setdefault(worker_id, telemetry.now())
 
     def retire(self, worker_id: int) -> None:
         """A worker finished cleanly: stop monitoring it (so
@@ -202,11 +211,17 @@ class HostParameterServer:
         empty): workers silent — no pull or commit — for more than
         ``timeout`` seconds.  PS traffic is the natural heartbeat: an
         alive PS-family worker contacts the server every communication
-        window; one that is silent is stalled, partitioned, or dead."""
-        now = time.monotonic()
+        window; one that is silent is stalled, partitioned, or dead.
+
+        Heartbeats are stamped with ``telemetry.now()`` — the same
+        monotonic clock as every other host timestamp in the repo —
+        so idleness compares cleanly against serving/trainer spans."""
+        now = telemetry.now()
         with self._lock:
-            return sorted(w for w, seen in self._last_seen.items()
+            idle = sorted(w for w, seen in self._last_seen.items()
                           if now - seen > timeout)
+        telemetry.metrics().gauge("ps_idle_workers").set(len(idle))
+        return idle
 
 
 class PSServer:
@@ -270,9 +285,16 @@ class PSServer:
                 pass
 
     def _serve(self, conn: socket.socket):
+        # per-direction wire totals (message bodies; the 4-byte frame
+        # headers are omitted — negligible against parameter payloads)
+        rx = telemetry.metrics().counter("ps_wire_bytes_total",
+                                         direction="rx")
+        tx = telemetry.metrics().counter("ps_wire_bytes_total",
+                                         direction="tx")
         with conn:
             try:
                 hello = transport.recv_msg(conn)
+                rx.inc(len(hello))
                 worker_id = int.from_bytes(hello[:4], "big")
                 codec = None
                 if len(hello) > 4:
@@ -282,10 +304,13 @@ class PSServer:
                     codec = resolve_codec(hello[4:].decode())
                 while True:
                     msg = transport.recv_msg(conn)
+                    rx.inc(len(msg))
                     cmd, body = msg[:1], msg[1:]
                     if cmd == b"p":
-                        transport.send_msg(conn, pack_params(
-                            self.ps.pull(worker_id), self._template))
+                        wire = pack_params(
+                            self.ps.pull(worker_id), self._template)
+                        tx.inc(len(wire))
+                        transport.send_msg(conn, wire)
                     elif cmd == b"c":
                         seq = int.from_bytes(body[:8], "big")
                         if seq == _NO_SEQ:
@@ -298,14 +323,14 @@ class PSServer:
                                 self._template, body[8:])
                         local = None
                         if self.ps.rule.pull_uses_local:
-                            local = unpack_params(
-                                self._template,
-                                transport.recv_msg(conn))
+                            raw = transport.recv_msg(conn)
+                            rx.inc(len(raw))
+                            local = unpack_params(self._template, raw)
                         pulled = self.ps.commit(worker_id, payload,
                                                 local, seq=seq)
-                        transport.send_msg(conn,
-                                           pack_params(
-                                               pulled, self._template))
+                        wire = pack_params(pulled, self._template)
+                        tx.inc(len(wire))
+                        transport.send_msg(conn, wire)
                     elif cmd == b"d":
                         # clean worker finish: retire from liveness
                         # monitoring and drop its dedupe reply

@@ -42,6 +42,7 @@ from typing import Any, Callable, Mapping
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.parallel.update_rules import (
     DynSGDRule,
     ElasticRule,
@@ -85,6 +86,12 @@ def make_round_fn(rule: UpdateRule, step_fn: Callable,
 
     def round_fn(ps_state: PSState, worker_states: TrainState,
                  batches: Mapping[str, jnp.ndarray], perm: jnp.ndarray):
+        # Python side effect at TRACE time only: the emulated arms run
+        # whole rounds as one XLA program, so "compiles per fidelity"
+        # is the honest host-visible counter (per-round spans live in
+        # the trainer loop, which drives this program from the host).
+        telemetry.metrics().counter("ps_round_compiles_total",
+                                    fidelity=fidelity).inc()
         num_workers = perm.shape[0]
         window = jax.tree_util.tree_leaves(batches)[0].shape[1]
         center = ps_state.center
@@ -172,6 +179,9 @@ def make_pipelined_round_fn(rule: UpdateRule,
                  batches: Mapping[str, jnp.ndarray], perm: jnp.ndarray,
                  pending: Pytree, pending_perm: jnp.ndarray,
                  pending_valid: jnp.ndarray):
+        # trace-time compile counter (see make_round_fn)
+        telemetry.metrics().counter("ps_round_compiles_total",
+                                    fidelity="pipelined").inc()
         num_workers = perm.shape[0]
         window = jax.tree_util.tree_leaves(batches)[0].shape[1]
         start = worker_states.params  # pulls adopted at last round end

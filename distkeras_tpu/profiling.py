@@ -89,6 +89,50 @@ def time_step_chain(step_fn, state, batch, n: int = 20,
     return (time.perf_counter() - t0) / n, value
 
 
+def telemetry_overhead(n: int = 200_000) -> dict:
+    """Measured per-call cost (ns) of the telemetry hot-path
+    primitives, disabled vs enabled — the number PERF.md §24 quotes
+    and ``scripts/obs_report.py`` re-measures.  Restores the global
+    telemetry state it found.
+
+    The disabled arm is what every instrumented call site pays when
+    telemetry is off (the tier-1 / perf-row fast path): a registry
+    lookup returning the shared no-op metric, and the shared no-op
+    span.  The enabled arm adds the real lock + dict work.
+    """
+    from distkeras_tpu import telemetry
+
+    def per_call_ns(fn) -> float:
+        fn()  # warm any lazy allocation out of the timed loop
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    def inc_op():
+        telemetry.metrics().counter("overhead_probe").inc()
+
+    def span_op():
+        with telemetry.span("overhead_probe"):
+            pass
+
+    prior = telemetry.get() if telemetry.enabled() else None
+    out = {}
+    try:
+        telemetry.disable()
+        out["disabled_counter_inc_ns"] = round(per_call_ns(inc_op), 1)
+        out["disabled_span_ns"] = round(per_call_ns(span_op), 1)
+        telemetry.enable()
+        out["enabled_counter_inc_ns"] = round(per_call_ns(inc_op), 1)
+        out["enabled_span_ns"] = round(per_call_ns(span_op), 1)
+    finally:
+        if prior is not None:
+            telemetry.enable(telemetry=prior)
+        else:
+            telemetry.disable()
+    return out
+
+
 @contextlib.contextmanager
 def profiler_trace(log_dir: str | None) -> Iterator[None]:
     """``jax.profiler`` trace hook: no-op when ``log_dir`` is None, so

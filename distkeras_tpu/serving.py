@@ -37,18 +37,27 @@ independent of admission order (each slot's attention reads only its
 own cache rows).  Sampling draws from the engine's step/prefill key
 stream, so it is reproducible for a fixed seed and arrival order but
 NOT admission-order invariant.
+
+Observability (``distkeras_tpu.telemetry``; no-op until
+``telemetry.enable()``): per-bucket ``serving_queue_depth`` /
+``serving_slot_occupancy`` gauges, ``serving_ttft_seconds`` /
+``serving_latency_seconds`` histograms, token/request/finish counters,
+trace-time ``compiles_total{kind,bucket[,padded]}`` (the public face
+of ``compile_counts``), and ``prefill``/``decode_step`` spans +
+``evict`` instants on the serving thread's timeline track.  Request
+timing stamps all read ``telemetry.now()`` — see ``_finish``.
 """
 
 from __future__ import annotations
 
 import collections
-import time
 from typing import Iterable, Iterator, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
 
@@ -71,7 +80,7 @@ class _Request:
         self.tokens: list[int] = []
         self.meta = meta
         self.submit_order = submit_order
-        self.t_submit = time.perf_counter()
+        self.t_submit = telemetry.now()
         self.t_first = None
 
 
@@ -224,9 +233,13 @@ class DecodeEngine:
         pad_id, n_sub = self.pad_id, self.steps_per_sync
 
         def step_impl(variables, cache, state, rng):
-            # Python side effect: runs at TRACE time only, so this
-            # counts compilations — the compile-guard test's probe.
+            # Python side effects: run at TRACE time only, so these
+            # count compilations — the compile-guard test's probe.
+            # The registry counter sees only compiles that happen
+            # while telemetry is enabled (enable before construction).
             self._traces["step", env] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="step", bucket=env).inc()
             params = {"params": variables["params"]}
 
             def body(carry, sub):
@@ -269,6 +282,9 @@ class DecodeEngine:
             # trace-time counter: one compile per (bucket, padded
             # prompt length) — the bounded prefill program set
             self._traces["prefill", env, prompt.shape[1]] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="prefill", bucket=env,
+                padded=prompt.shape[1]).inc()
             params = {"params": variables["params"]}
             logits, st = dec.apply(params, prompt, mutable=["cache"],
                                    last_index=last_idx)
@@ -340,6 +356,10 @@ class DecodeEngine:
                        dict(meta or {}), self._n_submitted)
         self._n_submitted += 1
         pool.queue.append(req)
+        m = telemetry.metrics()
+        m.counter("serving_requests_total", bucket=pool.env).inc()
+        m.gauge("serving_queue_depth",
+                bucket=pool.env).set(len(pool.queue))
         return req.rid
 
     def _next_rng(self):
@@ -356,8 +376,19 @@ class DecodeEngine:
                 "mid-stream; drain the engine first")
         self._n_rng = 0
 
+    def _note_gauges(self, pool: _Pool) -> None:
+        """Per-bucket queue-depth / slot-occupancy gauges — the levels
+        an operator correlates with a TTFT spike (no-op while
+        telemetry is disabled)."""
+        m = telemetry.metrics()
+        m.gauge("serving_queue_depth",
+                bucket=pool.env).set(len(pool.queue))
+        m.gauge("serving_slot_occupancy", bucket=pool.env).set(
+            sum(r is not None for r in pool.reqs))
+
     def _admit(self) -> list[dict]:
         finished = []
+        m = telemetry.metrics()
         for pool in self._pools:
             for slot in range(pool.n_slots):
                 if not pool.queue:
@@ -370,32 +401,61 @@ class DecodeEngine:
                             _ceil_to(t_p, self.prefill_align))
                 padded = np.full((1, t_pad), self.pad_id, np.int32)
                 padded[0, :t_p] = req.prompt
-                pool.cache, pool.state, tok0 = pool.prefill_fn(
-                    self.variables, pool.cache, pool.state,
-                    jnp.asarray(padded), slot, t_p - 1,
-                    req.max_new - 1,
-                    -1 if req.eos_id is None else req.eos_id,
-                    self._next_rng())
-                req.tokens.append(int(tok0))
-                req.t_first = time.perf_counter()
+                with telemetry.span("prefill", bucket=pool.env,
+                                    slot=slot, padded=t_pad,
+                                    request_id=req.rid):
+                    pool.cache, pool.state, tok0 = pool.prefill_fn(
+                        self.variables, pool.cache, pool.state,
+                        jnp.asarray(padded), slot, t_p - 1,
+                        req.max_new - 1,
+                        -1 if req.eos_id is None else req.eos_id,
+                        self._next_rng())
+                    req.tokens.append(int(tok0))
+                req.t_first = telemetry.now()
+                m.counter("serving_tokens_total",
+                          bucket=pool.env).inc()
                 pool.reqs[slot] = req
                 if (req.max_new == 1
                         or req.tokens[-1] == req.eos_id):
                     finished.append(self._finish(pool, slot))
+            self._note_gauges(pool)
         return finished
 
     def _finish(self, pool: _Pool, slot: int) -> dict:
+        """Evict the finished request and assemble its result dict.
+
+        Timing fields (all from ``telemetry.now()``, the repo's single
+        monotonic clock — differences are meaningful, absolute values
+        are not):
+
+        * ``t_submit`` — when ``submit()`` queued the request;
+        * ``t_first``  — when its first token materialized on the host
+          (prefill return), i.e. queue-to-first-token is
+          ``ttft = t_first - t_submit``;
+        * ``t_finish`` — when the finished request was evicted;
+          completion latency is ``latency = t_finish - t_submit``.
+
+        The derived ``ttft``/``latency`` keys ride along precomputed.
+        Engine-owned keys (including the timing fields above) win over
+        same-named meta keys — ordered delivery depends on
+        ``request_id`` surviving."""
         req = pool.reqs[slot]
         pool.reqs[slot] = None
-        # host-clock serving telemetry: queue-to-first-token is
-        # t_first - t_submit; completion latency t_finish - t_submit.
-        # Engine-owned keys win over same-named meta keys — ordered
-        # delivery depends on request_id surviving.
+        t_finish = telemetry.now()
+        ttft = req.t_first - req.t_submit
+        latency = t_finish - req.t_submit
+        m = telemetry.metrics()
+        m.counter("serving_finished_total", bucket=pool.env).inc()
+        m.histogram("serving_ttft_seconds").observe(ttft)
+        m.histogram("serving_latency_seconds").observe(latency)
+        telemetry.instant("evict", bucket=pool.env, slot=slot,
+                          request_id=req.rid)
         return {**req.meta,
                 "request_id": req.rid, "prompt": req.prompt,
                 "tokens": np.asarray(req.tokens, np.int32),
                 "t_submit": req.t_submit, "t_first": req.t_first,
-                "t_finish": time.perf_counter()}
+                "t_finish": t_finish, "ttft": ttft,
+                "latency": latency}
 
     # ---- serving loop -------------------------------------------------
 
@@ -407,14 +467,20 @@ class DecodeEngine:
         bucket by ``steps_per_sync`` tokens, evict newly finished
         requests and return their results (as-completed order)."""
         finished = self._admit()
+        m = telemetry.metrics()
         for pool in self._pools:
             if not pool.live():
                 continue
-            pool.cache, pool.state, toks, was_done = pool.step_fn(
-                self.variables, pool.cache, pool.state,
-                self._next_rng())
-            toks = np.asarray(toks)
-            was_done = np.asarray(was_done)
+            # the span covers dispatch AND the host sync (np.asarray),
+            # so its duration is the true step-quantum latency
+            with telemetry.span("decode_step", bucket=pool.env,
+                                steps=self.steps_per_sync):
+                pool.cache, pool.state, toks, was_done = pool.step_fn(
+                    self.variables, pool.cache, pool.state,
+                    self._next_rng())
+                toks = np.asarray(toks)
+                was_done = np.asarray(was_done)
+            n_tok = 0
             for slot, req in enumerate(pool.reqs):
                 if req is None:
                     continue
@@ -422,10 +488,15 @@ class DecodeEngine:
                     if was_done[k, slot]:
                         break
                     req.tokens.append(int(toks[k, slot]))
+                    n_tok += 1
                     if (len(req.tokens) >= req.max_new
                             or req.tokens[-1] == req.eos_id):
                         finished.append(self._finish(pool, slot))
                         break
+            if n_tok:
+                m.counter("serving_tokens_total",
+                          bucket=pool.env).inc(n_tok)
+            self._note_gauges(pool)
         finished.extend(self._admit())
         return finished
 

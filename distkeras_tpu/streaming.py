@@ -21,6 +21,7 @@ from typing import Any, Iterable, Iterator, Mapping
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.predictors import ModelPredictor
 from distkeras_tpu.utils import pad_to_multiple
@@ -57,7 +58,10 @@ class StreamingPredictor(ModelPredictor):
         x = np.stack([np.asarray(r[self.features_col]) for r in rows])
         n = len(x)
         x = pad_to_multiple(x, self.batch_size, axis=0)
-        out = self._forward(self.variables, jnp.asarray(x))
+        telemetry.metrics().counter(
+            "streaming_rows_total", kind="predict").inc(n)
+        with telemetry.span("predict_flush", rows=n):
+            out = self._forward(self.variables, jnp.asarray(x))
         if isinstance(out, tuple):
             # multi-output model: one key per head, mirroring
             # ModelPredictor's column-per-head contract
@@ -244,8 +248,13 @@ class StreamingGenerator:
         if n < self.batch_size:  # dummy-ROW padding (tail flush only)
             pad = np.repeat(prompts[-1:], self.batch_size - n, axis=0)
             prompts = np.concatenate([prompts, pad], axis=0)
+        m = telemetry.metrics()
+        m.counter("streaming_rows_total", kind="generate").inc(n)
+        m.counter("streaming_pad_rows_total").inc(len(prompts) - n)
         rng = jax.random.fold_in(jax.random.key(self.seed), n_flush)
-        out = self._generate(self.variables, jnp.asarray(prompts), rng)
+        with telemetry.span("bucket_flush", prompt_len=t_p, rows=n):
+            out = self._generate(self.variables, jnp.asarray(prompts),
+                                 rng)
         if self.num_beams > 1:
             seqs, scores = (np.asarray(out[0]), np.asarray(out[1]))
             return {i: {**row, self.output_col: seqs[j, t_p:],

@@ -1,0 +1,838 @@
+"""Unified telemetry — one metrics registry and one trace timeline for
+every concurrent layer of the stack (SURVEY.md §5 "honest
+observability": the reference records only wall-clock ``training_time``).
+
+Before this module the repo's telemetry was fragmented: trainers
+appended to per-instance ``history`` dicts under a hand-rolled lock, the
+decode engine stamped raw ``t_submit/t_first/t_finish`` floats onto
+requests with ``time.perf_counter``, and the host PS tracked heartbeats
+privately with ``time.monotonic`` — three bookkeeping systems on two
+clocks, none able to answer "what was queue depth when p99 TTFT
+spiked?".  This module is the one place all of that lands:
+
+* ``now()`` — THE host-side monotonic clock.  Every host timestamp in
+  the repo (serving request stamps, PS heartbeats, span boundaries,
+  stall timers) reads this single source, so durations computed across
+  subsystems are always on one clock.
+* ``MetricsRegistry`` — thread-safe counters, gauges, fixed-bucket
+  histograms, and append-only series (the trainer-``history`` backing).
+  ``snapshot()`` for programmatic reads, ``write_jsonl()`` for logs,
+  ``prometheus_text()`` + an opt-in background ``http.server`` thread
+  (``serve()``) for live ``/metrics`` scraping.
+* ``Tracer`` — ``with span("commit", worker=i):`` records thread-aware
+  complete events into a bounded in-memory ring; ``write_chrome_trace``
+  dumps Chrome trace-event JSON loadable in Perfetto, so the racing
+  host-PS arm (handler threads, worker threads, retry/idle events),
+  trainer rounds, and ``DecodeEngine`` admissions / prefills /
+  step-quanta / evictions all land on one timeline with one thread
+  track each.
+
+Disabled-by-default fast path: the module-level singleton starts as a
+no-op ``Telemetry`` whose metric handles and spans are shared inert
+objects — an instrumented hot path pays one attribute lookup and one
+no-op call (measured sub-microsecond; PERF.md §24) — so tier-1 numerics
+and perf rows are untouched until ``enable()`` is called.  Trainer
+``history`` uses private always-on registries (a ``MetricsRegistry`` is
+just objects + a lock), independent of the global switch.
+
+Everything here is stdlib-only by design: no prometheus_client, no
+opentelemetry — the export FORMATS are the interop point.
+
+Usage::
+
+    from distkeras_tpu import telemetry
+    tel = telemetry.enable()              # flip the global switch
+    ... run trainers / engine ...
+    tel.metrics.write_jsonl("metrics.jsonl")
+    tel.tracer.write_chrome_trace("trace.json")   # open in Perfetto
+    telemetry.disable()
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Iterator, Mapping
+
+#: THE host-side monotonic clock (satellite: serving ``t_submit`` /
+#: ``t_first`` / ``t_finish``, host-PS ``_last_seen``, and every span
+#: boundary read this one source).  ``perf_counter`` is monotonic with
+#: the highest available resolution; its origin is arbitrary, so values
+#: are only meaningful as differences — never persist them as wall
+#: times.
+now = time.perf_counter
+
+#: Default histogram bucket upper bounds, in seconds — latency-shaped
+#: (1 ms .. 60 s).  Counts accumulate cumulatively per Prometheus
+#: convention; values above the last edge land in +Inf only.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: Staleness-shaped buckets (commit depths, not seconds).
+STALENESS_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _label_key(name: str, labels: Mapping[str, Any]) -> str:
+    """Prometheus-style series key: ``name{a="1",b="x"}`` (labels
+    sorted, values coerced to str)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonically increasing count (thread-safe)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-set value (thread-safe); ``inc``/``dec`` for level-style
+    gauges (queue depth, slot occupancy)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (thread-safe): cumulative bucket counts
+    per Prometheus convention, plus count/sum/min/max for snapshot
+    consumers that want quick percentile estimates."""
+
+    __slots__ = ("buckets", "_lock", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        edges = tuple(float(b) for b in buckets)
+        if not edges or any(nxt <= prev
+                            for nxt, prev in zip(edges[1:], edges)):
+            raise ValueError(
+                f"histogram buckets must be strictly increasing and "
+                f"non-empty; got {buckets!r}")
+        self.buckets = edges
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(edges) + 1)  # +1: the +Inf bucket
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = 0
+        for edge in self.buckets:
+            if v <= edge:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+            lo, hi = self._min, self._max
+        cum, cumulative = 0, {}
+        for edge, n in zip(self.buckets, counts):
+            cum += n
+            cumulative[edge] = cum
+        return {"count": total, "sum": s,
+                "min": None if total == 0 else lo,
+                "max": None if total == 0 else hi,
+                "buckets": cumulative}
+
+    def percentile(self, q: float) -> float | None:
+        """Bucket-resolution estimate of the q-th percentile (q in
+        [0, 1]): the upper edge of the first bucket whose cumulative
+        count covers q — an over-estimate by at most one bucket width,
+        the standard fixed-bucket tradeoff.  None when empty."""
+        snap = self.snapshot()
+        if snap["count"] == 0:
+            return None
+        need = q * snap["count"]
+        for edge, cum in snap["buckets"].items():
+            if cum >= need:
+                return edge
+        return snap["max"]
+
+
+class Series:
+    """Thread-safe append-only value log — the backing store for
+    trainer ``history`` keys (per-round losses, staleness lists,
+    failure records): things that are a sequence of observations, not a
+    counter or a distribution."""
+
+    __slots__ = ("_lock", "_values")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: list = []
+
+    def append(self, v) -> None:
+        with self._lock:
+            self._values.append(v)
+
+    def extend(self, vs) -> None:
+        with self._lock:
+            self._values.extend(vs)
+
+    def replace(self, vs) -> None:
+        with self._lock:
+            self._values = list(vs)
+
+    def values(self) -> list:
+        with self._lock:
+            return list(self._values)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._values)
+
+
+class _NoopMetric:
+    """Shared inert metric handle: every mutator is a no-op, every read
+    is empty/zero.  One instance serves every disabled call site."""
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def append(self, v) -> None:
+        pass
+
+    def extend(self, vs) -> None:
+        pass
+
+    value = 0.0
+    count = 0
+
+    def values(self) -> list:
+        return []
+
+    def snapshot(self) -> dict:
+        return {}
+
+
+_NOOP_METRIC = _NoopMetric()
+
+
+class MetricsRegistry:
+    """Thread-safe name+labels -> metric store.
+
+    ``counter``/``gauge``/``histogram``/``series`` are get-or-create:
+    the first call materializes the metric, later calls (any thread)
+    return the same object, so hot paths may either cache the handle or
+    re-look it up.  Export three ways: ``snapshot()`` (one nested
+    dict), ``write_jsonl(path)`` (one JSON object per metric, greppable
+    logs), ``prometheus_text()`` (text exposition; pair with
+    ``serve()`` for a live ``/metrics`` endpoint).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, tuple[str, str, dict, Any]] = {}
+        self._httpd = None
+        self._http_thread = None
+
+    # -- get-or-create ------------------------------------------------
+
+    def _get(self, kind: str, name: str, labels: dict, make):
+        key = _label_key(name, labels)
+        with self._lock:
+            got = self._metrics.get(key)
+            if got is None:
+                got = (kind, name, {k: str(v)
+                                    for k, v in labels.items()}, make())
+                self._metrics[key] = got
+            elif got[0] != kind:
+                raise ValueError(
+                    f"metric {key!r} already registered as {got[0]}, "
+                    f"not {kind}")
+            return got[3]
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(self, name: str, buckets=None, **labels) -> Histogram:
+        make = (Histogram if buckets is None
+                else lambda: Histogram(buckets))
+        return self._get("histogram", name, labels, make)
+
+    def series(self, name: str, **labels) -> Series:
+        return self._get("series", name, labels, Series)
+
+    # -- queries ------------------------------------------------------
+
+    def collect(self, name: str, **label_filter
+                ) -> list[tuple[dict, Any]]:
+        """All (labels, metric) pairs for ``name`` whose labels are a
+        superset of ``label_filter`` — e.g. every per-padded-length
+        prefill compile counter of one bucket."""
+        want = {k: str(v) for k, v in label_filter.items()}
+        with self._lock:
+            items = list(self._metrics.values())
+        return [(labels, m) for kind, n, labels, m in items
+                if n == name and all(labels.get(k) == v
+                                     for k, v in want.items())]
+
+    def sum_counter(self, name: str, **label_filter) -> float:
+        return sum(m.value
+                   for _, m in self.collect(name, **label_filter))
+
+    def snapshot(self) -> dict:
+        """``{"counters": {key: value}, "gauges": {key: value},
+        "histograms": {key: {...}}, "series": {key: [...]}}`` — keys
+        are Prometheus-style ``name{label="v"}`` strings."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                     "series": {}}
+        for key, (kind, _, _, m) in items:
+            if kind == "counter":
+                out["counters"][key] = m.value
+            elif kind == "gauge":
+                out["gauges"][key] = m.value
+            elif kind == "histogram":
+                out["histograms"][key] = m.snapshot()
+            else:
+                out["series"][key] = m.values()
+        return out
+
+    def write_jsonl(self, path: str | os.PathLike) -> str:
+        """One JSON object per metric: ``{"kind", "name", "labels",
+        ...kind-specific payload}``.  Series values must be
+        JSON-encodable (trainer history already is — it rides the
+        msgpack checkpoint cursor as JSON)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        lines = []
+        for key, (kind, name, labels, m) in items:
+            rec = {"kind": kind, "name": name, "labels": labels,
+                   "key": key}
+            if kind == "histogram":
+                snap = m.snapshot()
+                snap["buckets"] = {str(k): v
+                                   for k, v in snap["buckets"].items()}
+                rec.update(snap)
+            elif kind == "series":
+                rec["values"] = m.values()
+            else:
+                rec["value"] = m.value
+            lines.append(json.dumps(rec))
+        p = os.fspath(path)
+        with open(p, "w") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+        return p
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4): counters and
+        gauges verbatim; histograms as cumulative ``_bucket{le=}`` +
+        ``_sum``/``_count``; series as an untyped last-value sample
+        plus a ``_total`` observation count (full series history is a
+        log concern — ``write_jsonl`` — not a scrape concern)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        by_name: dict[str, list] = {}
+        kinds: dict[str, str] = {}
+        for key, (kind, name, labels, m) in items:
+            by_name.setdefault(name, []).append((labels, m))
+            kinds[name] = kind
+        out: list[str] = []
+        for name in sorted(by_name):
+            kind = kinds[name]
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram",
+                     "series": "untyped"}[kind]
+            out.append(f"# TYPE {name} {ptype}")
+            for labels, m in by_name[name]:
+                if kind in ("counter", "gauge"):
+                    out.append(f"{_label_key(name, labels)} {m.value}")
+                elif kind == "histogram":
+                    snap = m.snapshot()
+                    for edge, cum in snap["buckets"].items():
+                        out.append(_label_key(
+                            name + "_bucket",
+                            {**labels, "le": edge}) + f" {cum}")
+                    out.append(_label_key(
+                        name + "_bucket", {**labels, "le": "+Inf"})
+                        + f" {snap['count']}")
+                    out.append(f"{_label_key(name + '_sum', labels)} "
+                               f"{snap['sum']}")
+                    out.append(f"{_label_key(name + '_count', labels)} "
+                               f"{snap['count']}")
+                else:
+                    vals = m.values()
+                    last = vals[-1] if vals else float("nan")
+                    if not isinstance(last, (int, float, bool)):
+                        last = float("nan")  # structured series entry
+                    out.append(f"{_label_key(name, labels)} "
+                               f"{float(last)}")
+                    out.append(
+                        f"{_label_key(name + '_observations', labels)}"
+                        f" {len(vals)}")
+        return "\n".join(out) + "\n"
+
+    # -- the opt-in /metrics thread -----------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0
+              ) -> tuple[str, int]:
+        """Start a background daemon thread serving ``GET /metrics``
+        (Prometheus text) and ``GET /metrics.json`` (the snapshot).
+        Returns the bound ``(host, port)``; ``port=0`` picks a free
+        one.  Call ``stop_serving()`` to shut it down."""
+        if self._httpd is not None:
+            return self._httpd.server_address[:2]
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] == "/metrics":
+                    body = registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(registry.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not stdout news
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dkt-metrics-http")
+        self._http_thread.start()
+        return self._httpd.server_address[:2]
+
+    def stop_serving(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._http_thread.join()
+            self._httpd = self._http_thread = None
+
+
+class NullRegistry:
+    """Disabled-path registry: every lookup returns the shared inert
+    metric, every export is empty.  Keeps instrumented call sites
+    branch-free."""
+
+    def counter(self, name: str, **labels) -> _NoopMetric:
+        return _NOOP_METRIC
+
+    def gauge(self, name: str, **labels) -> _NoopMetric:
+        return _NOOP_METRIC
+
+    def histogram(self, name: str, buckets=None,
+                  **labels) -> _NoopMetric:
+        return _NOOP_METRIC
+
+    def series(self, name: str, **labels) -> _NoopMetric:
+        return _NOOP_METRIC
+
+    def collect(self, name: str, **label_filter) -> list:
+        return []
+
+    def sum_counter(self, name: str, **label_filter) -> float:
+        return 0.0
+
+    def snapshot(self) -> dict:
+        return {"counters": {}, "gauges": {}, "histograms": {},
+                "series": {}}
+
+    def prometheus_text(self) -> str:
+        return ""
+
+
+class _Span:
+    """One ``with``-scoped trace span: ts taken at enter, a Chrome
+    complete ("X") event appended to the ring at exit.  Exceptions
+    inside the span mark ``args["error"]`` and re-raise."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = now()
+        args = self.args
+        if exc_type is not None:
+            args = {**args, "error": exc_type.__name__}
+        self._tracer._complete(self.name, self._t0, t1, args)
+        return False
+
+
+class _NoopSpan:
+    """Shared reusable disabled span — ``with`` costs two no-op calls.
+    Safe to share across threads and nestings: enter/exit carry no
+    state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+# Trace-track thread ids: ``threading.get_ident()`` values are REUSED
+# once a thread exits, which would merge sequential threads onto one
+# Perfetto track under the first thread's name.  Stamp each thread with
+# a process-unique id instead (module-global so every Tracer agrees).
+_tid_lock = threading.Lock()
+_tid_next = [1]
+
+
+def _thread_trace_id() -> int:
+    t = threading.current_thread()
+    tid = getattr(t, "_dkt_trace_tid", None)
+    if tid is None:
+        with _tid_lock:
+            tid = getattr(t, "_dkt_trace_tid", None)
+            if tid is None:
+                tid = _tid_next[0]
+                _tid_next[0] += 1
+                t._dkt_trace_tid = tid
+    return tid
+
+
+class Tracer:
+    """Bounded in-memory ring of Chrome trace events.
+
+    ``span(name, **args)`` records a complete ("X") event per thread;
+    ``instant(name, **args)`` a thread-scoped instant ("i") event.
+    The ring (``collections.deque(maxlen=capacity)``) keeps the LAST
+    ``capacity`` events — a long run keeps its newest window, which is
+    the window you are debugging.  ``write_chrome_trace(path)`` dumps
+    the Chrome trace-event JSON object format (``{"traceEvents":
+    [...]}``) with thread-name metadata, loadable in Perfetto /
+    ``chrome://tracing``.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self.capacity = capacity
+        self._ring: collections.deque = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self._thread_names: dict[int, str] = {}
+        self._pid = os.getpid()
+
+    # -- recording ----------------------------------------------------
+
+    def _note_thread(self) -> int:
+        tid = _thread_trace_id()
+        if tid not in self._thread_names:
+            with self._lock:
+                self._thread_names[tid] = \
+                    threading.current_thread().name
+        return tid
+
+    def _complete(self, name: str, t0: float, t1: float,
+                  args: dict) -> None:
+        tid = self._note_thread()
+        # deque.append is atomic under the GIL; events land in ring
+        # order per thread (append happens at span exit)
+        self._ring.append({
+            "name": name, "ph": "X", "ts": t0 * 1e6,
+            "dur": max(t1 - t0, 0.0) * 1e6,
+            "pid": self._pid, "tid": tid, "args": args})
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args)
+
+    def complete(self, name: str, t0: float, t1: float,
+                 **args) -> None:
+        """Record a complete event from explicit ``now()`` stamps —
+        the minimal-diff alternative to ``with span(...)`` for long
+        loop bodies that would otherwise re-indent wholesale."""
+        self._complete(name, t0, t1, args)
+
+    def instant(self, name: str, **args) -> None:
+        tid = self._note_thread()
+        self._ring.append({
+            "name": name, "ph": "i", "ts": now() * 1e6, "s": "t",
+            "pid": self._pid, "tid": tid, "args": args})
+
+    # -- export -------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object: ring events plus
+        process/thread-name metadata records."""
+        with self._lock:
+            names = dict(self._thread_names)
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": "distkeras_tpu"}}]
+        for tid, tname in sorted(names.items()):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": tname}})
+        return {"traceEvents": meta + self.events(),
+                "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str | os.PathLike) -> str:
+        p = os.fspath(path)
+        with open(p, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return p
+
+
+class NullTracer:
+    """Disabled-path tracer: spans are the shared no-op span."""
+
+    capacity = 0
+
+    def span(self, name: str, **args) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def complete(self, name: str, t0: float, t1: float,
+                 **args) -> None:
+        pass
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def events(self) -> list:
+        return []
+
+    def __len__(self) -> int:
+        return 0
+
+    def clear(self) -> None:
+        pass
+
+
+class Telemetry:
+    """One metrics registry + one tracer, the pair ``enable()``
+    installs globally."""
+
+    def __init__(self, metrics: MetricsRegistry | None = None,
+                 tracer: Tracer | None = None):
+        self.metrics = MetricsRegistry() if metrics is None else metrics
+        self.tracer = Tracer() if tracer is None else tracer
+
+    enabled = True
+
+    def span(self, name: str, **args):
+        return self.tracer.span(name, **args)
+
+    def instant(self, name: str, **args) -> None:
+        self.tracer.instant(name, **args)
+
+
+class _NullTelemetry:
+    enabled = False
+
+    def __init__(self):
+        self.metrics = NullRegistry()
+        self.tracer = NullTracer()
+
+    def span(self, name: str, **args) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+
+_NULL = _NullTelemetry()
+_active: Any = _NULL
+_active_lock = threading.Lock()
+
+
+def get() -> Any:
+    """The active ``Telemetry`` (or the shared no-op when disabled).
+    Hot paths may cache ``get().metrics`` handles only for the scope of
+    one operation — the switch can flip between operations."""
+    return _active
+
+
+def enabled() -> bool:
+    return _active.enabled
+
+
+def metrics() -> Any:
+    """The active metrics registry (Null when disabled)."""
+    return _active.metrics
+
+
+def tracer() -> Any:
+    return _active.tracer
+
+
+def span(name: str, **args):
+    """``with telemetry.span("commit", worker=i):`` — no-op (one shared
+    inert context manager) while disabled."""
+    return _active.tracer.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    _active.tracer.instant(name, **args)
+
+
+def complete(name: str, t0: float, **args) -> None:
+    """Record a complete event from ``t0`` (a ``now()`` stamp the
+    caller took at the start of the bracketed work) to now."""
+    _active.tracer.complete(name, t0, now(), **args)
+
+
+def enable(ring_capacity: int = 65536,
+           telemetry: Telemetry | None = None) -> Telemetry:
+    """Install (and return) the global ``Telemetry``.  Idempotent-ish:
+    enabling while enabled replaces the active instance (pass an
+    existing ``Telemetry`` to install a pre-built one).  NOTE —
+    compile-event counters are recorded at program TRACE time, so
+    enable telemetry before constructing the engine/trainer whose
+    compiles you want counted."""
+    global _active
+    with _active_lock:
+        tel = telemetry if telemetry is not None else Telemetry(
+            tracer=Tracer(capacity=ring_capacity))
+        _active = tel
+    return tel
+
+
+def disable() -> None:
+    """Restore the no-op fast path (stops the /metrics thread if the
+    active registry started one).  Existing handles into the old
+    registry stay valid — they just stop being globally visible."""
+    global _active
+    with _active_lock:
+        old, _active = _active, _NULL
+    if isinstance(getattr(old, "metrics", None), MetricsRegistry):
+        old.metrics.stop_serving()
+
+
+class HistoryView(collections.abc.Mapping):
+    """Trainer ``history`` as a read view over a ``MetricsRegistry``'s
+    series (SURVEY.md §5 / ISSUE 2 tentpole: one bookkeeping system,
+    not two).  ``view[key]`` returns a list copy of the series values;
+    the Mapping ABC supplies ``get``/``in``/``keys``/``items``.
+    Writers go through the registry (``Trainer._record``); ``replace``
+    repopulates from a checkpointed plain dict on resume."""
+
+    __slots__ = ("_registry",)
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+
+    def _series(self) -> dict[str, Series]:
+        with self._registry._lock:
+            items = list(self._registry._metrics.values())
+        return {name: m for kind, name, _, m in items
+                if kind == "series" and len(m) > 0}
+
+    def __getitem__(self, key: str) -> list:
+        got = self._series().get(key)
+        if got is None:
+            raise KeyError(key)
+        return got.values()
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._series())
+
+    def __len__(self) -> int:
+        return len(self._series())
+
+    def __repr__(self) -> str:
+        return f"HistoryView({dict(self)!r})"
+
+    def replace(self, mapping: Mapping[str, list]) -> None:
+        """Reset the backing series to ``mapping`` (checkpoint
+        resume).  Series absent from ``mapping`` are emptied, so the
+        view equals the checkpointed history exactly."""
+        for name, s in self._series().items():
+            if name not in mapping:
+                s.replace([])
+        for k, v in mapping.items():
+            self._registry.series(k).replace(list(v))

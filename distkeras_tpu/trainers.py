@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu import telemetry
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import ModelSpec
 from distkeras_tpu.parallel import tensor_parallel
@@ -171,12 +172,12 @@ def _epoch_segments(dataset, seed: int, stall: list | None = None):
 
     def timed():
         while True:
-            t0 = time.monotonic()
+            t0 = telemetry.now()
             try:
                 item = next(it)
             except StopIteration:
                 return
-            stall[0] += time.monotonic() - t0
+            stall[0] += telemetry.now() - t0
             yield item
     return timed()
 
@@ -288,7 +289,16 @@ class Trainer:
         self.checkpoint_dir = checkpoint_dir
         self.profile_dir = profile_dir
         self.training_time: float = 0.0
-        self.history: dict[str, list] = {}
+        # ``history`` is a read VIEW over this trainer's own metrics
+        # registry (ISSUE 2: one bookkeeping system, not a second
+        # hand-rolled dict): ``_record`` appends to thread-safe
+        # registry series, the dict-like read surface — history[k],
+        # .get, ``in`` — is unchanged.  The per-trainer registry is
+        # always on (history must exist with global telemetry
+        # disabled) and exportable like any other registry
+        # (``trainer.metrics.write_jsonl(...)``).
+        self.metrics = telemetry.MetricsRegistry()
+        self.history = telemetry.HistoryView(self.metrics)
         self.trained_variables: dict | None = None
 
     # -- shared plumbing ---------------------------------------------------
@@ -310,7 +320,7 @@ class Trainer:
 
     def _record(self, **kwargs):
         for k, v in kwargs.items():
-            self.history.setdefault(k, []).append(v)
+            self.metrics.series(k).append(v)
 
     def train(self, dataset: Dataset, initial_variables=None,
               resume_from: str | None = None,
@@ -334,7 +344,9 @@ class Trainer:
         self._eval_dataset = eval_dataset
         start = time.time()
         try:
-            with profiler_trace(self.profile_dir):
+            with profiler_trace(self.profile_dir), \
+                    telemetry.span("train",
+                                   trainer=type(self).__name__):
                 return self._train(dataset, initial_variables,
                                    resume_from)
         finally:
@@ -376,7 +388,8 @@ class Trainer:
         if self.checkpoint_dir is not None:
             from distkeras_tpu import checkpoint as ckpt
 
-            cursor = {**cursor, "history": self.history}
+            # materialize the registry view: the cursor is JSON-encoded
+            cursor = {**cursor, "history": dict(self.history)}
             if getattr(self, "_sharded_ckpt", False):
                 # multi-host sharded state: every process writes only
                 # its own shards (orbax)
@@ -401,9 +414,10 @@ class Trainer:
                         ckpt.SHARDED, ignore_errors=True)
 
     def _restore_history(self, cursor: dict) -> dict:
-        """Pop the checkpointed history into ``self.history``."""
-        self.history = {k: list(v)
-                        for k, v in cursor.pop("history", {}).items()}
+        """Pop the checkpointed history into the registry-backed view
+        (the view object stays; its backing series are reset)."""
+        self.history.replace({
+            k: list(v) for k, v in cursor.pop("history", {}).items()})
         return cursor
 
     def _maybe_resume(self, resume_from, state_template):
@@ -435,6 +449,7 @@ class SingleTrainer(Trainer):
         run_chunk = jax.jit(make_window_runner(step))
 
         for epoch in range(start_epoch, self.num_epoch):
+            t_epoch = telemetry.now()
             losses = []
             stall = [0.0]
             for segment in _epoch_segments(dataset, self.seed + epoch,
@@ -459,6 +474,8 @@ class SingleTrainer(Trainer):
                          segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
+            telemetry.complete("epoch", t_epoch, epoch=epoch,
+                               trainer=type(self).__name__)
         self.trained_variables = state.variables()
         return self.trained_variables
 
@@ -595,6 +612,7 @@ class SyncTrainer(Trainer):
         start_epoch = int(cursor.get("epoch", 0))
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
+            t_epoch = telemetry.now()
             pending = []
             stall = [0.0]
             for segment in _epoch_segments(dataset, self.seed + epoch,
@@ -620,6 +638,8 @@ class SyncTrainer(Trainer):
                 segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
+            telemetry.complete("epoch", t_epoch, epoch=epoch,
+                               trainer=type(self).__name__)
         self.trained_variables = state.variables()
         return self.trained_variables
 
@@ -701,6 +721,7 @@ class SyncTrainer(Trainer):
         start_epoch = int(cursor.get("epoch", 0))
         self.num_workers = num_workers
         for epoch in range(start_epoch, self.num_epoch):
+            t_epoch = telemetry.now()
             pending = []
             stall = [0.0]
             for segment in _epoch_segments(dataset, self.seed + epoch,
@@ -737,6 +758,8 @@ class SyncTrainer(Trainer):
                 segment_stall_s=round(stall[0], 4))
             self._eval_epoch(state.variables())
             self._maybe_save(state, {"epoch": epoch + 1})
+            telemetry.complete("epoch", t_epoch, epoch=epoch,
+                               trainer=type(self).__name__)
         self.trained_variables = state.variables()
         return self.trained_variables
 
@@ -1145,6 +1168,7 @@ class DistributedTrainer(Trainer):
                      "perm_key": perm_key}, point)
 
         for epoch in range(start_epoch, self.num_epoch):
+            t_epoch = telemetry.now()
             resuming_mid_epoch = epoch == start_epoch and start_round > 0
             if resuming_mid_epoch:
                 # this epoch's pre-kill rounds live in the restored
@@ -1234,9 +1258,9 @@ class DistributedTrainer(Trainer):
                     if record_this_segment:
                         self._record(skipped_segment_rows=seg_rows)
                     continue
-                t_get = time.monotonic()
+                t_get = telemetry.now()
                 segment = prefetch.get(seg_j, load_segment)
-                seg_stall += time.monotonic() - t_get
+                seg_stall += telemetry.now() - t_get
                 if _prefetch_depth() > 0:
                     nxt = next_loadable(seg_j, round_base)
                     if nxt is not None:
@@ -1273,6 +1297,7 @@ class DistributedTrainer(Trainer):
                     r = round_base + r_local
                     if r < first_round:
                         continue  # resume: rounds already in the ckpt
+                    t_round = telemetry.now()
                     perm_key, sub = jax.random.split(perm_key)
                     perm = jax.random.permutation(sub, num_workers)
                     # [W, window, B, ...] device batch for this round;
@@ -1307,6 +1332,10 @@ class DistributedTrainer(Trainer):
                     if pending is not None:
                         drain(pending)
                     pending = metrics
+                    # host-side round span (dispatch + previous-round
+                    # drain; device time lives in profiler traces)
+                    telemetry.complete("ps_round", t_round,
+                                       epoch=epoch, round=r)
                     every = self.checkpoint_every_rounds
                     if every and (r + 1) % every == 0:
                         if r_local + 1 < seg_rounds:
@@ -1342,6 +1371,8 @@ class DistributedTrainer(Trainer):
                     "params": ps_state.center,
                     **slice_row0(worker_states.model_state)})
             save_point({"epoch": epoch + 1, "round": 0})
+            telemetry.complete("epoch", t_epoch, epoch=epoch,
+                               trainer=type(self).__name__)
 
         # Keep worker 0's model state (batch stats etc.): slice on device
         # (replicated output) so only one row ever crosses to host.
@@ -1449,11 +1480,15 @@ class DistributedTrainer(Trainer):
         worker_keys = jax.random.split(
             jax.random.key(self.seed + 1), num_workers)
         cols = self._columns()
-        history_lock = threading.Lock()
-        round_records: list[tuple[int, int, float]] = []
-        retry_records: list[tuple[int, int, int]] = []
-        failures: list[tuple[int, BaseException]] = []
-        byte_totals = [0, 0]  # [wire, raw] commit bytes (codec arm)
+        # Thread-shared accumulators are telemetry primitives (ISSUE 2:
+        # the hand-rolled history_lock is gone) — Series/Counter carry
+        # their own locks, so worker threads append race-free and the
+        # post-join code snapshots once.
+        round_records = telemetry.Series()  # (worker, epoch, loss)
+        retry_records = telemetry.Series()  # (worker, epoch, round)
+        failures = telemetry.Series()       # (worker, exception)
+        wire_total = telemetry.Counter()    # codec-arm commit bytes
+        raw_total = telemetry.Counter()
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -1480,6 +1515,7 @@ class DistributedTrainer(Trainer):
                                   - set(local_workers))
         dropped_per_epoch = [0] * self.num_epoch
         skipped_rows_per_epoch = [0] * self.num_epoch
+        accum_lock = threading.Lock()  # the two index+= arrays above
 
         def _sweep_shard_cache():
             # caller holds shard_lock: drop READY entries every live
@@ -1616,8 +1652,9 @@ class DistributedTrainer(Trainer):
                             raise
                         if client is not None:
                             client.close()
-                        with history_lock:
-                            retry_records.append((w, -1, -1))
+                        retry_records.append((w, -1, -1))
+                        telemetry.instant("worker_retry", worker=w,
+                                          phase="startup")
                 for epoch in range(self.num_epoch):
                     epoch_rounds = 0  # global round id across segments
                     for slot in range(len(epoch_plan(epoch))):
@@ -1633,19 +1670,20 @@ class DistributedTrainer(Trainer):
                             # slice; summed over workers ~= the
                             # segment)
                             rows = epoch_plan(epoch)[slot][0]
-                            with history_lock:
+                            with accum_lock:
                                 skipped_rows_per_epoch[epoch] += (
                                     len(shard) if shard is not None
                                     else rows // num_workers)
                             continue
                         n_batches = len(next(iter(stacked.values())))
                         seg_rounds = n_batches // window
-                        with history_lock:
+                        with accum_lock:
                             dropped_per_epoch[epoch] += (
                                 n_batches - seg_rounds * window)
                         for r_local in range(seg_rounds):
                             r = epoch_rounds
                             epoch_rounds += 1
+                            t_round = telemetry.now()
                             batches = {
                                 k: jnp.asarray(
                                     v[r_local * window:
@@ -1738,13 +1776,21 @@ class DistributedTrainer(Trainer):
                                     if attempts > self.worker_retries:
                                         raise
                                     reconnect = True
-                                    with history_lock:
-                                        retry_records.append((w, epoch, r))
-                            with history_lock:
-                                round_records.append(
-                                    (w, epoch,
-                                     float(np.mean(
-                                         np.asarray(metrics["loss"])))))
+                                    retry_records.append((w, epoch, r))
+                                    telemetry.instant("worker_retry",
+                                                      worker=w,
+                                                      epoch=epoch,
+                                                      round=r)
+                            round_records.append(
+                                (w, epoch,
+                                 float(np.mean(
+                                     np.asarray(metrics["loss"])))))
+                            # one span per worker round on this
+                            # worker thread's track — the acceptance
+                            # timeline next to ps_commit spans
+                            telemetry.complete("worker_round",
+                                               t_round, worker=w,
+                                               epoch=epoch, round=r)
                     if epoch_rounds == 0:
                         raise ValueError(
                             f"worker {w}: not enough batches per "
@@ -1762,9 +1808,11 @@ class DistributedTrainer(Trainer):
                 # telemetry flush runs even for workers that die
                 # mid-run — their applied commits' traffic was real
                 if codec is not None:
-                    with history_lock:
-                        byte_totals[0] += wire_bytes
-                        byte_totals[1] += raw_bytes
+                    wire_total.inc(wire_bytes)
+                    raw_total.inc(raw_bytes)
+                    m = telemetry.metrics()
+                    m.counter("commit_wire_bytes_total").inc(wire_bytes)
+                    m.counter("commit_raw_bytes_total").inc(raw_bytes)
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in local_workers]
@@ -1789,6 +1837,8 @@ class DistributedTrainer(Trainer):
                     idle = ps.idle_workers(self.worker_timeout)
                     if idle and (not detected or detected[-1] != idle):
                         detected.append(idle)
+                        # timeline marker on the watchdog's own track
+                        telemetry.instant("idle_workers", workers=idle)
 
             watcher = threading.Thread(target=watchdog, daemon=True)
             watcher.start()
@@ -1811,6 +1861,10 @@ class DistributedTrainer(Trainer):
             self._record(detected_idle_workers=detected)
         if server is not None:
             server.stop()
+        # threads are joined: snapshot the shared accumulators once
+        failures = failures.values()
+        retry_records = retry_records.values()
+        round_records = round_records.values()
         total_failures = len(failures)
         if multi:
             total_failures = int(multihost_utils.process_allgather(
@@ -1830,10 +1884,10 @@ class DistributedTrainer(Trainer):
             self._record(worker_failures=[(w, repr(e))
                                           for w, e in failures])
         if retry_records:
-            self._record(worker_round_retries=list(retry_records))
+            self._record(worker_round_retries=retry_records)
         if codec is not None:
-            self._record(commit_wire_bytes=byte_totals[0],
-                         commit_raw_bytes=byte_totals[1])
+            self._record(commit_wire_bytes=int(wire_total.value),
+                         commit_raw_bytes=int(raw_total.value))
 
         # round_loss is per-process telemetry (this process's workers);
         # epoch_loss / dropped tails are reduced globally so every
@@ -2053,6 +2107,7 @@ class _MemberParallelTrainer(Trainer):
         # the within-shard batch order reshuffles per epoch.
         member_shards = dataset.shuffle(seed=self.seed).repartition(n)
         for epoch in range(self.num_epoch):
+            t_epoch = telemetry.now()
             per_member = [
                 _stack_batches(
                     s.shuffle(seed=self.seed + 13 * epoch + i),
@@ -2083,6 +2138,8 @@ class _MemberParallelTrainer(Trainer):
             self._record(
                 epoch_loss=float(per_member_loss.mean()),
                 member_loss=[float(x) for x in per_member_loss])
+            telemetry.complete("epoch", t_epoch, epoch=epoch,
+                               trainer=type(self).__name__)
         return states
 
     def _guard_no_checkpoint(self, resume_from):

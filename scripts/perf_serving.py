@@ -204,6 +204,12 @@ def main():
                          "high-RTT links; admission granularity)")
     ap.add_argument("--arms", default="baseline,single,bucketed")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="enable telemetry and dump a Perfetto-"
+                         "loadable Chrome trace of the run here")
+    ap.add_argument("--metrics", default=None, metavar="PATH",
+                    help="enable telemetry and dump the metrics "
+                         "registry as JSONL here")
     args = ap.parse_args()
 
     if args.smoke:
@@ -219,6 +225,16 @@ def main():
     from distkeras_tpu.models import model_config, ModelSpec
     import jax
     import jax.numpy as jnp
+
+    # telemetry consumer: enabled BEFORE engine construction so the
+    # trace-time compile counters see every program.  Smoke always
+    # enables it — tier-1 then exercises the instrumented serving
+    # paths end to end.
+    tel = None
+    if args.trace or args.metrics or args.smoke:
+        from distkeras_tpu import telemetry
+
+        tel = telemetry.enable()
 
     spec = model_config(
         "transformer_lm", (args.max_len,), input_dtype="int32",
@@ -277,6 +293,27 @@ def main():
                 out["arms"][arm]["speedup_vs_baseline"] = round(
                     out["arms"][arm]["goodput_tok_s"] / base, 3)
 
+    if tel is not None:
+        # registry-side view of the same run: TTFT percentiles from
+        # the histogram (bucket resolution), total generated tokens,
+        # the bounded compiled-program set
+        ttft = tel.metrics.histogram("serving_ttft_seconds")
+        snap = tel.metrics.snapshot()
+        out["telemetry"] = {
+            "ttft_p50_s": ttft.percentile(0.5),
+            "ttft_p95_s": ttft.percentile(0.95),
+            "requests_finished": ttft.count,
+            "tokens_total": tel.metrics.sum_counter(
+                "serving_tokens_total"),
+            "compiled_programs": sum(
+                1 for k in snap["counters"]
+                if k.startswith("compiles_total")),
+        }
+        if args.metrics:
+            tel.metrics.write_jsonl(args.metrics)
+        if args.trace:
+            tel.tracer.write_chrome_trace(args.trace)
+
     if args.smoke:
         # greedy parity: each continuous arm's tokens are the
         # baseline generation truncated to the request's budget
@@ -291,6 +328,11 @@ def main():
                     got = runs[arm]["tokens"][i]
                     assert np.array_equal(got, want), (arm, i, got,
                                                        want)
+        # the registry saw the run: finished requests + live gauges
+        assert out["telemetry"]["requests_finished"] > 0
+        assert out["telemetry"]["tokens_total"] > 0
+        assert any(k.startswith("serving_slot_occupancy")
+                   for k in tel.metrics.snapshot()["gauges"])
         out["smoke_parity"] = "ok"
     print(json.dumps(out))
 

@@ -1,0 +1,117 @@
+"""History-key lint (ISSUE 2 satellite): every ``history[...]`` key a
+trainer emits must have a row in the docs/API.md "Trainer history
+keys" table — keys like ``detected_idle_workers`` or
+``commit_wire_bytes`` were previously discoverable only by reading
+trainers.py.  The collection runs one representative trainer per
+history-emitting code path (sequential, sync-DP, emulated PS with
+out-of-core segments, the chaos-path host arm, members, eval hook) and
+fails on any UNDOCUMENTED emitted key; a core set is also required to
+actually appear, so the table cannot go stale silently."""
+
+import pathlib
+import re
+import time
+
+import jax
+import pytest
+
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import model_config
+from distkeras_tpu.trainers import (
+    ADAG,
+    DOWNPOUR,
+    EnsembleTrainer,
+    SingleTrainer,
+    SyncTrainer,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+DOCS = pathlib.Path(__file__).resolve().parent.parent / "docs/API.md"
+
+MLP = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+DATA = datasets.synthetic_classification(512, (8,), 4, seed=0)
+
+
+def documented_keys() -> set[str]:
+    """First-column backticked keys of the history-key table."""
+    text = DOCS.read_text()
+    m = re.search(r"### Trainer history keys(.*?)(?:\n## |\Z)", text,
+                  re.S)
+    assert m, "docs/API.md lacks the 'Trainer history keys' table"
+    keys = set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+    assert keys, "history-key table parsed empty"
+    return keys
+
+
+class _Bomb(Exception):
+    pass
+
+
+def _collect_emitted() -> set[str]:
+    emitted: set[str] = set()
+
+    def run(trainer, data=DATA, **kw):
+        trainer.train(data, **kw)
+        emitted.update(trainer.history.keys())
+        return trainer
+
+    run(SingleTrainer(MLP, batch_size=32, num_epoch=1),
+        eval_dataset=DATA.take(128))
+    run(SyncTrainer(MLP, num_workers=2, batch_size=16, num_epoch=1))
+    run(EnsembleTrainer(MLP, num_models=2, batch_size=32, num_epoch=1))
+
+    # emulated PS over a sharded dataset with a runt shard: covers
+    # round_loss/staleness plus the skip/drop bookkeeping keys
+    import tempfile
+
+    from distkeras_tpu.data.dataset import Dataset
+
+    with tempfile.TemporaryDirectory() as d:
+        DATA.take(130).to_npz_shards(f"{d}/part", rows_per_shard=64)
+        sharded = Dataset.from_npz_shards(f"{d}/part*.npz")
+        run(ADAG(MLP, num_workers=4, communication_window=2,
+                 batch_size=8, num_epoch=1, learning_rate=5e-3,
+                 fidelity="faithful"), data=sharded)
+
+    # host arm chaos paths in one run: a transient failure (retry), a
+    # hard failure (tolerated death), a stall (watchdog detection),
+    # and wire compression (byte totals)
+    state = {"transient": True, "stall": True}
+
+    def injector(w, epoch, r):
+        if w == 0 and r == 1 and state.pop("transient", False):
+            raise _Bomb("transient")
+        if w == 1:
+            raise _Bomb("hard")
+        if w == 2 and r == 1 and state.pop("stall", False):
+            time.sleep(1.2)
+
+    run(DOWNPOUR(MLP, fidelity="host", num_workers=3,
+                 communication_window=2, batch_size=16, num_epoch=1,
+                 learning_rate=0.01, worker_optimizer="adam",
+                 worker_retries=1, max_worker_failures=1,
+                 worker_timeout=0.3, fault_injector=injector,
+                 compression="int8"))
+    return emitted
+
+
+def test_every_emitted_history_key_is_documented():
+    documented = documented_keys()
+    emitted = _collect_emitted()
+    undocumented = emitted - documented
+    assert not undocumented, (
+        f"history keys emitted but missing from the docs/API.md "
+        f"'Trainer history keys' table: {sorted(undocumented)}")
+    # the lint itself must keep teeth: the chaos/members/eval paths
+    # above are expected to exercise at least this core set
+    core = {"epoch_loss", "round_loss", "staleness",
+            "segment_stall_s", "dropped_tail_batches",
+            "skipped_segment_rows", "eval_accuracy", "member_loss",
+            "worker_failures", "worker_round_retries",
+            "commit_wire_bytes", "commit_raw_bytes"}
+    missing = core - emitted
+    assert not missing, (
+        f"collection no longer exercises core history keys: "
+        f"{sorted(missing)}")
+    assert core <= documented

@@ -117,31 +117,51 @@ def test_max_new_tokens_one_and_instant_eos_finish_at_prefill():
 
 
 def test_compile_count_guard_steady_state():
-    """The §23 bounded-program-set claim, pinned: one step program per
-    bucket + one prefill program per (bucket, padded length); a second
-    ragged workload in a DIFFERENT arrival order triggers ZERO new
-    traces."""
-    model, variables = _model()
-    eng = DecodeEngine(model, variables, slots=2, buckets=[16, 32],
-                       prefill_align=8, max_new_tokens=4)
-    lengths = [3, 9, 5, 14, 7, 2, 11, 8]
-    eng_reqs = lambda ls: [{"prompt": p}  # noqa: E731
-                           for p in _prompts(ls, seed=11)]
-    list(eng.run(eng_reqs(lengths)))
-    counts = dict(eng.compile_counts)
-    # bounded set: steps per bucket, prefills per (bucket, padded len)
-    assert counts[("step", 16)] == 1 and counts[("step", 32)] == 1
-    for key, n in counts.items():
-        assert n == 1, (key, n)
-    prefill_shapes = {k for k in counts if k[0] == "prefill"}
-    # padded lengths are multiples of prefill_align within the bucket
-    assert prefill_shapes <= {("prefill", 16, 8), ("prefill", 16, 16),
-                              ("prefill", 32, 8), ("prefill", 32, 16),
-                              ("prefill", 32, 24), ("prefill", 32, 32)}
-    # ragged re-arrivals, shuffled: nothing new compiles
-    list(eng.run(eng_reqs(list(reversed(lengths)))))
-    list(eng.run(eng_reqs([7, 7, 3, 9, 2])))
-    assert dict(eng.compile_counts) == counts
+    """The §23 bounded-program-set claim, pinned via the PUBLIC
+    telemetry counter ``compiles_total{kind,bucket[,padded]}`` (ISSUE 2:
+    compile events are registry metrics, not private engine state): one
+    step program per bucket + one prefill program per (bucket, padded
+    length); a second ragged workload in a DIFFERENT arrival order
+    triggers ZERO new traces."""
+    from distkeras_tpu import telemetry
+
+    tel = telemetry.enable()
+    try:
+        model, variables = _model()
+        eng = DecodeEngine(model, variables, slots=2, buckets=[16, 32],
+                           prefill_align=8, max_new_tokens=4)
+        lengths = [3, 9, 5, 14, 7, 2, 11, 8]
+        eng_reqs = lambda ls: [{"prompt": p}  # noqa: E731
+                               for p in _prompts(ls, seed=11)]
+        list(eng.run(eng_reqs(lengths)))
+        m = tel.metrics
+        # bounded set: one step trace per bucket...
+        assert m.counter("compiles_total", kind="step",
+                         bucket=16).value == 1
+        assert m.counter("compiles_total", kind="step",
+                         bucket=32).value == 1
+        # ...and one prefill trace per (bucket, padded length), padded
+        # lengths multiples of prefill_align within the bucket
+        prefills = m.collect("compiles_total", kind="prefill")
+        assert prefills
+        for labels, c in prefills:
+            assert c.value == 1, labels
+        shapes = {(int(l["bucket"]), int(l["padded"]))
+                  for l, _ in prefills}
+        assert shapes <= {(16, 8), (16, 16), (32, 8), (32, 16),
+                          (32, 24), (32, 32)}
+        counters_before = {
+            k: v for k, v in m.snapshot()["counters"].items()
+            if k.startswith("compiles_total")}
+        # ragged re-arrivals, shuffled: nothing new compiles
+        list(eng.run(eng_reqs(list(reversed(lengths)))))
+        list(eng.run(eng_reqs([7, 7, 3, 9, 2])))
+        counters_after = {
+            k: v for k, v in m.snapshot()["counters"].items()
+            if k.startswith("compiles_total")}
+        assert counters_after == counters_before
+    finally:
+        telemetry.disable()
 
 
 def test_bucket_routing_and_rejection():

@@ -1,0 +1,370 @@
+"""Unified telemetry (ISSUE 2): registry thread-safety under racing
+PS-style threads, Perfetto-format trace validity, the opt-in /metrics
+endpoint, and the two acceptance runs — an async host-PS (socket)
+training producing ONE Perfetto-loadable trace with PS commit spans and
+per-worker round spans on distinct thread tracks, and a mixed-length
+``DecodeEngine`` run whose metrics snapshot holds queue-depth /
+slot-occupancy gauges, a TTFT histogram, and per-bucket compile
+counters."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def tel():
+    t = telemetry.enable(ring_capacity=100_000)
+    yield t
+    telemetry.disable()
+
+
+# ---- registry ----------------------------------------------------------
+
+def test_registry_get_or_create_and_kind_conflicts():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("a_total", bucket=16)
+    assert reg.counter("a_total", bucket=16) is c
+    assert reg.counter("a_total", bucket=32) is not c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("a_total", bucket=16)
+    g = reg.gauge("depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+    s = reg.series("loss")
+    s.append(1.0)
+    s.extend([0.5, 0.25])
+    assert s.values() == [1.0, 0.5, 0.25] and len(s) == 3
+
+
+def test_histogram_buckets_percentiles_and_validation():
+    h = telemetry.Histogram(buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["min"] == 0.005 \
+        and snap["max"] == 5.0
+    assert snap["buckets"] == {0.01: 1, 0.1: 2, 1.0: 3}
+    assert h.percentile(0.5) == 0.1
+    assert h.percentile(1.0) == 5.0  # beyond the last edge -> max
+    assert telemetry.Histogram(buckets=(1, 2, 3)).percentile(0.5) \
+        is None
+    with pytest.raises(ValueError, match="strictly increasing"):
+        telemetry.Histogram(buckets=(1.0, 1.0))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        telemetry.Histogram(buckets=())
+
+
+def test_registry_thread_safety_racing_ps_arm_shape():
+    """The racing host-PS access pattern: N 'worker' threads and N
+    'handler' threads hammer one counter, one histogram, and one
+    series while a reader concurrently snapshots — final totals must
+    be exact (no lost updates), snapshots must never crash."""
+    reg = telemetry.MetricsRegistry()
+    n_threads, n_ops = 8, 500
+    stop = threading.Event()
+    snaps = []
+
+    def writer(i):
+        c = reg.counter("commits_total")
+        h = reg.histogram("staleness",
+                          buckets=telemetry.STALENESS_BUCKETS)
+        for k in range(n_ops):
+            c.inc()
+            h.observe(k % 7)
+            reg.series("round_loss").append((i, k))
+            # half the threads also race the get-or-create path
+            if i % 2:
+                reg.counter("wire_bytes", direction="rx").inc(10)
+
+    def reader():
+        while not stop.is_set():
+            snaps.append(reg.snapshot())
+            reg.prometheus_text()
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    total = n_threads * n_ops
+    assert reg.counter("commits_total").value == total
+    assert reg.histogram("staleness").count == total
+    assert len(reg.series("round_loss")) == total
+    assert reg.counter("wire_bytes", direction="rx").value == \
+        (n_threads // 2) * n_ops * 10
+    # concurrent snapshots were internally consistent and monotone
+    counts = [s["counters"].get("commits_total", 0) for s in snaps]
+    assert counts == sorted(counts)
+
+
+def test_prometheus_text_and_jsonl_export(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("reqs_total", bucket=16).inc(3)
+    reg.gauge("depth").set(2)
+    reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.05)
+    reg.series("epoch_loss").append(0.5)
+    txt = reg.prometheus_text()
+    assert "# TYPE reqs_total counter" in txt
+    assert 'reqs_total{bucket="16"} 3' in txt
+    assert 'lat_seconds_bucket{le="0.1"} 1' in txt
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in txt
+    assert "lat_seconds_count 1" in txt
+    assert "epoch_loss_observations 1" in txt
+    path = reg.write_jsonl(tmp_path / "m.jsonl")
+    recs = {r["key"]: r for r in map(json.loads, open(path))}
+    assert recs['reqs_total{bucket="16"}']["value"] == 3
+    assert recs["epoch_loss"]["values"] == [0.5]
+    assert recs["lat_seconds"]["count"] == 1
+
+
+def test_http_metrics_endpoint():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("up_total").inc()
+    host, port = reg.serve(port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        assert "up_total 1" in body
+        snap = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/metrics.json", timeout=10).read())
+        assert snap["counters"]["up_total"] == 1
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope",
+                                   timeout=10)
+    finally:
+        reg.stop_serving()
+
+
+def test_disabled_fast_path_is_inert():
+    telemetry.disable()
+    assert not telemetry.enabled()
+    m = telemetry.metrics()
+    # shared no-op handles: no state, no allocation per call site
+    assert m.counter("a") is m.counter("b") is m.gauge("c")
+    m.counter("a").inc()
+    m.histogram("h").observe(1.0)
+    assert m.snapshot()["counters"] == {}
+    with telemetry.span("x", k=1) as s:
+        inner = s
+    assert inner is telemetry.span("y")  # the one shared no-op span
+    telemetry.instant("e")
+    assert telemetry.tracer().events() == []
+
+
+# ---- tracer / Perfetto format -----------------------------------------
+
+def check_perfetto_valid(trace: dict) -> None:
+    """The validity contract: required ``ph``/``ts``/``pid``/``tid``
+    fields on every timed event, non-negative durations, per-thread
+    monotone completion timestamps (events append at span exit), and a
+    thread-name metadata record per thread track."""
+    events = trace["traceEvents"]
+    assert events, "empty trace"
+    named_tids = {e["tid"] for e in events
+                  if e.get("ph") == "M"
+                  and e.get("name") == "thread_name"}
+    ends: dict[int, float] = {}
+    for e in events:
+        assert e.get("ph") in ("X", "i", "M"), e
+        assert isinstance(e.get("pid"), int)
+        assert isinstance(e.get("tid"), int)
+        if e["ph"] == "M":
+            continue
+        assert isinstance(e["ts"], float) and e["ts"] >= 0
+        assert e["tid"] in named_tids
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+            end = e["ts"] + e["dur"]
+            assert end >= ends.get(e["tid"], 0.0)
+            ends[e["tid"]] = end
+    json.loads(json.dumps(trace))  # serializable as-is
+
+
+def test_tracer_ring_bound_and_span_args(tel):
+    small = telemetry.Tracer(capacity=4)
+    for i in range(10):
+        with small.span("s", i=i):
+            pass
+    evs = small.events()
+    assert len(evs) == 4 and [e["args"]["i"] for e in evs] == \
+        [6, 7, 8, 9]
+    with pytest.raises(RuntimeError):
+        with tel.span("fails"):
+            raise RuntimeError("boom")
+    err = [e for e in tel.tracer.events() if e["name"] == "fails"]
+    assert err[0]["args"]["error"] == "RuntimeError"
+
+
+def test_chrome_trace_multithreaded_perfetto_validity(tmp_path, tel):
+    def work(i):
+        for k in range(5):
+            with tel.span("outer", worker=i):
+                with tel.span("inner", k=k):
+                    pass
+            tel.instant("tick", worker=i)
+
+    threads = [threading.Thread(target=work, args=(i,),
+                                name=f"worker-{i}") for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with tel.span("main"):
+        pass
+    path = tel.tracer.write_chrome_trace(tmp_path / "trace.json")
+    trace = json.load(open(path))
+    check_perfetto_valid(trace)
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"worker-0", "worker-1", "worker-2"} <= names
+    spans_by_tid = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X":
+            spans_by_tid.setdefault(e["tid"], []).append(e)
+    assert len(spans_by_tid) == 4  # 3 workers + main
+
+
+# ---- acceptance: host-PS socket run on one timeline -------------------
+
+def test_host_ps_socket_run_single_perfetto_trace(tmp_path, tel):
+    """One async host-PS training run (socket fidelity) -> one
+    Perfetto-loadable trace with PS commit spans and per-worker round
+    spans on DISTINCT thread tracks, plus commit-rate counter and
+    staleness histogram in the same registry."""
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    data = datasets.synthetic_classification(1024, (8,), 4, seed=0)
+    t = DOWNPOUR(mlp, fidelity="host", transport="socket",
+                 num_workers=3, communication_window=2, batch_size=16,
+                 num_epoch=1, learning_rate=0.01,
+                 worker_optimizer="adam")
+    t.train(data)
+
+    path = tel.tracer.write_chrome_trace(tmp_path / "host_ps.json")
+    trace = json.load(open(path))
+    check_perfetto_valid(trace)
+
+    commit_tids = {e["tid"] for e in trace["traceEvents"]
+                   if e.get("ph") == "X" and e["name"] == "ps_commit"}
+    round_spans = [e for e in trace["traceEvents"]
+                   if e.get("ph") == "X"
+                   and e["name"] == "worker_round"]
+    round_tids = {e["tid"] for e in round_spans}
+    # every worker thread has its own round track...
+    assert {e["args"]["worker"] for e in round_spans} == {0, 1, 2}
+    assert len(round_tids) == 3
+    # ...and socket commits run on PS handler threads, not on them
+    assert commit_tids and commit_tids.isdisjoint(round_tids)
+
+    n_rounds = len(t.history["round_loss"])
+    assert tel.metrics.counter("ps_commits_total").value == n_rounds
+    assert tel.metrics.histogram("ps_commit_staleness").count == \
+        n_rounds
+    assert tel.metrics.counter("ps_wire_bytes_total",
+                               direction="rx").value > 0
+    assert tel.metrics.counter("ps_wire_bytes_total",
+                               direction="tx").value > 0
+    # the trainer's history stayed intact alongside (the view reads
+    # the trainer's own registry, not the global one)
+    assert len(t.history["staleness"][-1]) == n_rounds
+
+
+# ---- acceptance: DecodeEngine metrics snapshot ------------------------
+
+def _lm(max_len=32, vocab=37):
+    from distkeras_tpu.models import ModelSpec, model_config
+
+    spec = model_config("transformer_lm", (max_len,),
+                        input_dtype="int32", vocab_size=vocab,
+                        num_layers=1, d_model=32, num_heads=2,
+                        max_len=max_len, dtype="float32")
+    model = ModelSpec.from_config(spec).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, max_len), jnp.int32))
+    return model, variables
+
+
+def test_engine_mixed_run_metrics_snapshot_and_derived_keys(tel):
+    """Mixed-length DecodeEngine run -> snapshot holds queue-depth and
+    slot-occupancy gauges, a TTFT histogram, and per-bucket compile
+    counters; results carry engine-owned ``ttft``/``latency`` derived
+    from the unified clock (meta keys of the same name lose)."""
+    from distkeras_tpu.serving import DecodeEngine
+
+    model, variables = _lm()
+    eng = DecodeEngine(model, variables, slots=2, buckets=[16, 32],
+                       prefill_align=4, max_new_tokens=4)
+    rng = np.random.default_rng(3)
+    reqs = [{"prompt": rng.integers(0, 37, (t,)).astype(np.int32),
+             "ttft": "meta-must-lose", "i": i}
+            for i, t in enumerate([5, 9, 3, 14, 7])]
+    results = list(eng.run(reqs))
+    assert len(results) == 5
+    for r in results:
+        assert isinstance(r["ttft"], float)      # engine key wins
+        assert r["i"] in range(5)                # other meta survives
+        assert r["t_submit"] <= r["t_first"] <= r["t_finish"]
+        assert r["ttft"] == pytest.approx(r["t_first"] - r["t_submit"])
+        assert r["latency"] == pytest.approx(
+            r["t_finish"] - r["t_submit"])
+        assert 0 <= r["ttft"] <= r["latency"]
+
+    snap = tel.metrics.snapshot()
+    for env in (16, 32):
+        assert f'serving_queue_depth{{bucket="{env}"}}' \
+            in snap["gauges"]
+        assert f'serving_slot_occupancy{{bucket="{env}"}}' \
+            in snap["gauges"]
+        # drained engine: both levels ended at zero
+        assert snap["gauges"][
+            f'serving_slot_occupancy{{bucket="{env}"}}'] == 0
+        assert tel.metrics.counter("compiles_total", kind="step",
+                                   bucket=env).value == 1
+        assert tel.metrics.sum_counter("compiles_total",
+                                       kind="prefill",
+                                       bucket=env) >= 1
+    ttft = snap["histograms"]["serving_ttft_seconds"]
+    assert ttft["count"] == 5
+    lat = snap["histograms"]["serving_latency_seconds"]
+    assert lat["count"] == 5 and lat["sum"] >= ttft["sum"]
+    assert tel.metrics.sum_counter("serving_tokens_total") == \
+        sum(len(r["tokens"]) for r in results)
+    # timeline side: prefill/decode_step spans + evict instants
+    names = {e["name"] for e in tel.tracer.events()}
+    assert {"prefill", "decode_step", "evict"} <= names
+
+
+def test_engine_timing_fields_without_telemetry_enabled():
+    """The unified clock + derived keys are engine contract, not a
+    telemetry feature: with telemetry DISABLED the timing fields are
+    still present, ordered, and on one clock."""
+    telemetry.disable()
+    from distkeras_tpu.serving import DecodeEngine
+
+    model, variables = _lm()
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=3)
+    (r,) = list(eng.run([np.arange(5, dtype=np.int32)]))
+    assert r["t_submit"] <= r["t_first"] <= r["t_finish"]
+    assert r["ttft"] == pytest.approx(r["t_first"] - r["t_submit"])
+    assert r["latency"] == pytest.approx(r["t_finish"] - r["t_submit"])
