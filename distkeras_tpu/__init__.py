@@ -30,7 +30,7 @@ from distkeras_tpu.trainers import (  # noqa: F401
     Trainer,
 )
 from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
-from distkeras_tpu.serving import DecodeEngine  # noqa: F401
+from distkeras_tpu.serving import DecodeEngine, ShedError  # noqa: F401
 from distkeras_tpu.streaming import (  # noqa: F401
     StreamingGenerator,
     StreamingPredictor,

@@ -76,6 +76,29 @@ def save_checkpoint(path: str | os.PathLike, state: Pytree,
     return str(path)
 
 
+def save_ps_snapshot(path: str | os.PathLike, snapshot: Pytree) -> str:
+    """Atomic free-form msgpack write for ``HostParameterServer``
+    warm-restart snapshots (tmp + rename, same crash-safety contract as
+    ``save_checkpoint``).  Unlike the trainer checkpoints, a snapshot
+    is restored WITHOUT a template (the restarting server has none —
+    its state died with the old process), so this rides flax's
+    self-describing ``msgpack_serialize`` encoding."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    data = flax_serialization.msgpack_serialize(
+        jax.device_get(snapshot))
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+    return str(path)
+
+
+def load_ps_snapshot(path: str | os.PathLike) -> Pytree:
+    """Inverse of ``save_ps_snapshot`` — no template needed."""
+    return flax_serialization.msgpack_restore(
+        pathlib.Path(path).read_bytes())
+
+
 SHARDED = "ckpt_sharded"
 _POINTER = "LATEST"
 

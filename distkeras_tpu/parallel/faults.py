@@ -1,0 +1,243 @@
+"""Chaos transport — deterministic, seed-scheduled socket fault
+injection for the host-PS wire path.
+
+The repo already had an in-process chaos hook (``fault_injector`` on
+the host-arm trainers), but it raises from INSIDE the worker loop — it
+never exercises the real transport failure modes the retry machinery
+exists for: a peer resetting mid-exchange, a frame truncated between
+header and body, a stalled link, a partition during reconnect.
+``ChaosTransport`` wraps the module-level ``transport.connect`` /
+``send_msg`` / ``recv_msg`` functions (the single choke point every
+socket byte in the repo crosses: ``PSServer`` handlers, ``PSClient``,
+``stop_server``) and injects those faults on a schedule drawn from a
+pinned seed, so a chaos run is reproducible: the k-th transport
+operation always draws the same fault decision.
+
+Fault classes (SURVEY.md §5's failure-model rows, now executable):
+
+* ``reset``    — the socket is closed and ``ConnectionResetError``
+  raised before the operation touches the wire (peer died between
+  exchanges);
+* ``truncate`` — ``send_msg`` writes a strict prefix of the frame and
+  closes the socket (peer died MID-frame; the receiver sees a framing
+  error, the sender an I/O error — the lost-ack shape that commit-seq
+  dedupe exists for);
+* ``delay``    — the operation is stalled ``delay_s`` seconds first
+  (congestion / GC pause; trips watchdogs, not retries);
+* ``partition``— a one-shot window starting at a scheduled operation
+  index during which every ``connect`` is refused (the reconnect path
+  itself must survive, consuming backoff rather than retry budget).
+
+Ops are counted globally under a lock, so the *schedule* of injected
+faults is a pure function of the seed even though racing worker
+threads interleave nondeterministically — the chaos sweep asserts
+completion-within-budget, and ``counts`` reports exactly what fired.
+
+Usage::
+
+    with ChaosTransport(seed=7, reset_rate=0.05, truncate_rate=0.02,
+                        delay_rate=0.1, max_injections=6):
+        trainer.train(data)          # transport='socket'
+
+Injections are visible as ``chaos_injected_total{kind}`` counters on
+the telemetry registry and in ``.counts``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.parallel import transport
+
+KINDS = ("reset", "truncate", "delay", "partition")
+
+
+class ChaosTransport:
+    """Installable fault injector over ``parallel.transport``.
+
+    Args:
+      seed: pins the whole fault schedule (same seed → same decisions
+        at the same operation indices).
+      reset_rate / truncate_rate / delay_rate: per-operation injection
+        probabilities (truncation only applies to sends; the draw is
+        made — and the schedule stays aligned — on every op).
+      delay_s: stall length for ``delay`` faults.
+      partition_at: global op index at which a ONE-SHOT partition
+        begins (``None``: never); for the next ``partition_ops``
+        operations every ``connect`` raises ``ConnectionRefusedError``.
+      partition_ops: width of the partition window, in operations.
+      max_injections: hard cap on injected reset+truncate faults (so a
+        seeded run provably fits a retry budget; delays and the
+        partition window do not consume it — they cost time, not
+        retries).
+      skip_ops: operations at the very start of the run that are never
+        faulted (lets the handshake/first pull establish a baseline).
+    """
+
+    def __init__(self, seed: int = 0, *, reset_rate: float = 0.0,
+                 truncate_rate: float = 0.0, delay_rate: float = 0.0,
+                 delay_s: float = 0.02,
+                 partition_at: Optional[int] = None,
+                 partition_ops: int = 4,
+                 max_injections: Optional[int] = None,
+                 skip_ops: int = 0):
+        for name, rate in (("reset_rate", reset_rate),
+                           ("truncate_rate", truncate_rate),
+                           ("delay_rate", delay_rate)):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{name}={rate} outside [0, 1]")
+        self._rng = np.random.default_rng(seed)
+        self._rates = {"reset": float(reset_rate),
+                       "truncate": float(truncate_rate),
+                       "delay": float(delay_rate)}
+        self.delay_s = float(delay_s)
+        self.partition_at = partition_at
+        self.partition_ops = int(partition_ops)
+        self.max_injections = max_injections
+        self.skip_ops = int(skip_ops)
+        self._lock = threading.Lock()
+        self._op = 0
+        self._injected = 0
+        self.counts: dict[str, int] = {k: 0 for k in KINDS}
+        self._orig = None
+        self._installed = False
+
+    # -- schedule ----------------------------------------------------------
+
+    def _note(self, kind: str) -> None:
+        self.counts[kind] += 1
+        telemetry.metrics().counter("chaos_injected_total",
+                                    kind=kind).inc()
+
+    def _draw(self, op_kind: str):
+        """One scheduled decision; returns the fault to inject (or
+        None).  Called under the lock so op indices — and therefore the
+        rng stream — are globally ordered."""
+        with self._lock:
+            op = self._op
+            self._op += 1
+            # the rng is consumed on EVERY op, injectable or not, so
+            # the schedule is a pure function of (seed, op index)
+            u = float(self._rng.random())
+            if op < self.skip_ops:
+                return None
+            if (self.partition_at is not None and op_kind == "connect"
+                    and self.partition_at <= op
+                    < self.partition_at + self.partition_ops):
+                self._note("partition")
+                return "partition"
+            budget_left = (self.max_injections is None
+                           or self._injected < self.max_injections)
+            edge = 0.0
+            for kind in ("reset", "truncate", "delay"):
+                edge += self._rates[kind]
+                if u < edge:
+                    if kind == "truncate" and op_kind != "send":
+                        return None  # only sends can truncate
+                    if kind in ("reset", "truncate"):
+                        if not budget_left:
+                            return None
+                        self._injected += 1
+                    self._note(kind)
+                    return kind
+            return None
+
+    # -- wrapped operations ------------------------------------------------
+
+    def _connect(self, host, port, timeout=None):
+        fault = self._draw("connect")
+        if fault == "partition":
+            raise ConnectionRefusedError(
+                "chaos: partitioned (scheduled one-shot window)")
+        if fault == "delay":
+            telemetry.instant("chaos_delay", op="connect")
+            _sleep(self.delay_s)
+        if fault == "reset":
+            raise ConnectionResetError("chaos: connect reset")
+        return self._orig[0](host, port, timeout=timeout)
+
+    def _send_msg(self, sock, *parts):
+        fault = self._draw("send")
+        if fault == "delay":
+            telemetry.instant("chaos_delay", op="send")
+            _sleep(self.delay_s)
+        if fault == "reset":
+            _hard_close(sock)
+            raise ConnectionResetError("chaos: send reset")
+        if fault == "truncate":
+            data = transport.frame(*parts)
+            cut = 1 + int(self._cut_fraction() * (len(data) - 1))
+            cut = min(cut, len(data) - 1)  # ALWAYS a strict prefix
+            try:
+                sock.sendall(data[:cut])
+            finally:
+                _hard_close(sock)
+            raise ConnectionError(
+                f"chaos: frame truncated at {cut}/{len(data)} bytes")
+        return self._orig[1](sock, *parts)
+
+    def _cut_fraction(self) -> float:
+        with self._lock:
+            return float(self._rng.random())
+
+    def _recv_msg(self, sock):
+        fault = self._draw("recv")
+        if fault == "delay":
+            telemetry.instant("chaos_delay", op="recv")
+            _sleep(self.delay_s)
+        if fault == "reset":
+            _hard_close(sock)
+            raise ConnectionResetError("chaos: recv reset")
+        return self._orig[2](sock)
+
+    # -- install / uninstall ----------------------------------------------
+
+    def install(self) -> "ChaosTransport":
+        if self._installed:
+            raise RuntimeError("ChaosTransport already installed")
+        self._orig = (transport.connect, transport.send_msg,
+                      transport.recv_msg)
+        self._installed = True
+        transport.connect = self._connect
+        transport.send_msg = self._send_msg
+        transport.recv_msg = self._recv_msg
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        transport.connect, transport.send_msg, transport.recv_msg = (
+            self._orig)
+        self._installed = False
+        # self._orig is deliberately KEPT: a daemon PS handler thread
+        # may still be inside a wrapper (blocked on recv) when the
+        # module bindings are restored — it must find the originals,
+        # not a None
+
+    def __enter__(self) -> "ChaosTransport":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.counts.values())
+
+
+def _hard_close(sock) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _sleep(seconds: float) -> None:
+    if seconds > 0:
+        import time
+
+        time.sleep(seconds)

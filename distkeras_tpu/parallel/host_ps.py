@@ -30,9 +30,11 @@ Two transports:
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
-from typing import Any
+import time
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -104,7 +106,19 @@ class HostParameterServer:
     DynSGDParameterServer).
     """
 
-    def __init__(self, rule: UpdateRule, center: Pytree):
+    def __init__(self, rule: UpdateRule, center: Pytree, *,
+                 snapshot_path: str | os.PathLike | None = None,
+                 snapshot_every: int = 0):
+        """``snapshot_path`` + ``snapshot_every=N``: every N-th commit
+        atomically writes a warm-restart snapshot (center + clocks +
+        commit-seq dedupe table) BEFORE the commit's reply is released
+        — so with ``snapshot_every=1`` every acked commit is durable
+        and a kill/restart cycle is exactly-once end to end (a commit
+        applied-but-unacked is either in the snapshot, in which case
+        the retry dedupes, or lost with the snapshot, in which case
+        the retry re-applies it once).  Larger N amortizes the write:
+        commits after the last snapshot are recovered only if the
+        client retries them (unacked); acked ones are rolled back."""
         self.rule = rule
         self._lock = threading.Lock()
         self._center = _to_numpy(center)
@@ -112,6 +126,12 @@ class HostParameterServer:
         self._pull_clock: dict[int, int] = {}
         self.staleness_log: list[int] = []
         self.num_commits = 0
+        self.num_snapshots = 0
+        self._snapshot_path = snapshot_path
+        self._snapshot_every = int(snapshot_every)
+        if self._snapshot_every and snapshot_path is None:
+            raise ValueError(
+                "snapshot_every needs a snapshot_path to write to")
         self._last_seen: dict[int, float] = {}
         self._last_reply: dict[int, tuple[int, Pytree]] = {}
 
@@ -179,6 +199,11 @@ class HostParameterServer:
             pulled = _to_numpy(pulled)
             if seq is not None:
                 self._last_reply[worker_id] = (seq, pulled)
+            if (self._snapshot_every
+                    and self.num_commits % self._snapshot_every == 0):
+                # inside the lock, BEFORE the reply escapes: an acked
+                # commit is durable (see __init__)
+                self._write_snapshot_locked()
             return pulled
 
     @property
@@ -223,6 +248,75 @@ class HostParameterServer:
         telemetry.metrics().gauge("ps_idle_workers").set(len(idle))
         return idle
 
+    # -- snapshot / warm restart ------------------------------------------
+
+    def _snapshot_locked(self) -> dict:
+        # numpy leaves are replaced, never mutated, by commit — shallow
+        # references are a consistent point-in-time copy under the lock
+        return {
+            "center": self._center,
+            "clock": self._clock,
+            "num_commits": self.num_commits,
+            "pull_clock": {str(w): c
+                           for w, c in self._pull_clock.items()},
+            "staleness_log": np.asarray(self.staleness_log, np.int64),
+            "last_reply": {str(w): {"seq": np.uint64(seq),
+                                    "pulled": pulled}
+                           for w, (seq, pulled)
+                           in self._last_reply.items()},
+        }
+
+    def snapshot(self) -> dict:
+        """Point-in-time warm-restart state: center, commit clock,
+        per-worker pull clocks, staleness log, and the commit-seq
+        dedupe table (``last_reply`` — WITHOUT it a restarted server
+        would re-apply a retried commit whose ack was lost)."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _write_snapshot_locked(self) -> None:
+        from distkeras_tpu import checkpoint as ckpt
+
+        with telemetry.span("ps_snapshot", commits=self.num_commits):
+            ckpt.save_ps_snapshot(self._snapshot_path,
+                                  self._snapshot_locked())
+        self.num_snapshots += 1
+        telemetry.metrics().counter("ps_snapshots_total").inc()
+
+    def save_snapshot(self, path: str | os.PathLike) -> str:
+        """Write ``snapshot()`` atomically (``checkpoint`` machinery:
+        tmp + rename, msgpack encoding) — never observed half-written."""
+        from distkeras_tpu import checkpoint as ckpt
+
+        return ckpt.save_ps_snapshot(path, self.snapshot())
+
+    @classmethod
+    def from_snapshot(cls, rule: UpdateRule,
+                      snapshot: dict | str | os.PathLike, *,
+                      snapshot_path: str | os.PathLike | None = None,
+                      snapshot_every: int = 0) -> "HostParameterServer":
+        """Warm-restart a server from ``snapshot()`` output or a path
+        written by ``save_snapshot``/periodic snapshotting.  The rule
+        must match the one that produced the snapshot (the center IS
+        the rule's durable state; the commit clock and dedupe table
+        restore staleness bookkeeping and at-most-once semantics for
+        reconnecting clients)."""
+        if isinstance(snapshot, (str, os.PathLike)):
+            from distkeras_tpu import checkpoint as ckpt
+
+            snapshot = ckpt.load_ps_snapshot(snapshot)
+        ps = cls(rule, snapshot["center"], snapshot_path=snapshot_path,
+                 snapshot_every=snapshot_every)
+        ps._clock = int(snapshot["clock"])
+        ps.num_commits = int(snapshot["num_commits"])
+        ps._pull_clock = {int(w): int(c) for w, c
+                          in snapshot["pull_clock"].items()}
+        ps.staleness_log = [int(s) for s
+                            in np.asarray(snapshot["staleness_log"])]
+        ps._last_reply = {int(w): (int(e["seq"]), e["pulled"])
+                          for w, e in snapshot["last_reply"].items()}
+        return ps
+
 
 class PSServer:
     """TCP front end for a ``HostParameterServer``.
@@ -251,6 +345,7 @@ class PSServer:
         self._sock.listen()
         self.address = self._sock.getsockname()
         self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True)
@@ -271,6 +366,7 @@ class PSServer:
                     break
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
                                 1)
+                self._conns.append(conn)
                 t = threading.Thread(target=self._serve, args=(conn,),
                                      daemon=True)
                 t.start()
@@ -362,6 +458,38 @@ class PSServer:
         except OSError:
             pass
 
+    def kill(self):
+        """Crash simulation: drop the listening socket AND every live
+        connection mid-exchange, keeping NO graceful-shutdown courtesy
+        (the dedupe cache is not cleared — a real crash would not
+        either; durable state is whatever the snapshots hold).  Clients
+        see ``ConnectionError`` and retry against ``restart_from``."""
+        self._stop.set()
+        for s in (self._sock, *self._conns):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @classmethod
+    def restart_from(cls, snapshot: dict | str | os.PathLike,
+                     rule: UpdateRule, template: Pytree, *,
+                     host: str = "127.0.0.1", port: int = 0,
+                     snapshot_path: str | os.PathLike | None = None,
+                     snapshot_every: int = 0) -> "PSServer":
+        """Warm restart: bring a killed PS back (typically on its old
+        port so reconnecting ``ResilientPSClient``s find it) from a
+        snapshot dict or file.  Commit-seq dedupe survives the restart,
+        so a client retrying a commit the dead server already applied
+        (and snapshotted) gets its cached reply instead of
+        double-applying the delta.  Returns a STARTED server."""
+        ps = HostParameterServer.from_snapshot(
+            rule, snapshot, snapshot_path=snapshot_path,
+            snapshot_every=snapshot_every)
+        telemetry.metrics().counter("ps_restarts_total").inc()
+        telemetry.instant("ps_restart", commits=ps.num_commits)
+        return cls(ps, template, host=host, port=port).start()
+
     def __enter__(self):
         return self.start()
 
@@ -452,6 +580,177 @@ class PSClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class PSRetryExhausted(ConnectionError):
+    """An operation kept failing past its retry budget; the last
+    underlying error is ``__cause__``.  Distinct from a transient
+    failure so callers (the trainer's round loop) can tell "the budget
+    is spent, die" from "recompute and try again"."""
+
+
+class _InProcessClient:
+    """The in-process arm behind the same client face as ``PSClient``:
+    direct method calls on a ``HostParameterServer``."""
+
+    def __init__(self, ps: HostParameterServer, worker_id: int):
+        self._ps = ps
+        self._w = worker_id
+
+    def pull(self) -> Pytree:
+        return self._ps.pull(self._w)
+
+    def commit(self, payload, local=None, seq=None) -> Pytree:
+        return self._ps.commit(self._w, payload, local, seq=seq)
+
+    def done(self):
+        self._ps.retire(self._w)
+
+    def close(self):
+        pass
+
+
+class ResilientPSClient:
+    """Self-healing PS client: reconnect + exponential backoff with
+    deterministic jitter + an explicit retry budget + at-most-once
+    commit seqs — the recovery logic that used to live inline in
+    ``trainers._train_host``'s worker loop, shared by trainers and
+    scripts.
+
+    The underlying connection is built lazily by ``factory`` (so the
+    FIRST contact consumes the same budget as any later one) and
+    rebuilt after every failure.  ``commit`` stamps a monotonic
+    per-client sequence number and retries with the IDENTICAL payload
+    bytes/tree, so a commit whose *ack* was lost is deduped server-side
+    instead of applied twice (``HostParameterServer.commit``); the seq
+    advances only after a confirmed reply.  Budget exhaustion raises
+    ``PSRetryExhausted`` (from the last error) rather than the raw
+    transport exception.
+
+    ``on_retry(attempt, exc)`` fires before each backoff sleep — the
+    trainer uses it to record ``worker_round_retries`` history and
+    ``worker_retry`` trace instants.  Jitter draws from a seeded rng,
+    so a chaos run's sleep schedule is reproducible.
+    """
+
+    def __init__(self, factory: Callable[[], Any], *, retries: int = 0,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 jitter: float = 0.5, seed: int = 0,
+                 use_seq: bool = True,
+                 on_retry: Optional[Callable[[int, Exception], None]]
+                 = None):
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter} outside [0, 1]")
+        self._factory = factory
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.use_seq = bool(use_seq)
+        self.on_retry = on_retry
+        self._rng = np.random.default_rng(seed)
+        self._raw = None
+        self._seq = 0
+        self.retry_count = 0
+
+    @classmethod
+    def for_address(cls, host: str, port: int, *, worker_id: int,
+                    template: Pytree, codec=None, **kwargs
+                    ) -> "ResilientPSClient":
+        """Socket arm: (re)connects a ``PSClient`` to a ``PSServer``."""
+        return cls(lambda: PSClient(host, port, worker_id=worker_id,
+                                    template=template, codec=codec),
+                   **kwargs)
+
+    @classmethod
+    def for_server(cls, ps: HostParameterServer, worker_id: int,
+                   **kwargs) -> "ResilientPSClient":
+        """In-process arm.  Commits there are atomic (apply-and-reply
+        under the server mutex — no lost-ack window), so dedupe seqs
+        default off and no reply cache is kept per worker."""
+        kwargs.setdefault("use_seq", False)
+        return cls(lambda: _InProcessClient(ps, worker_id), **kwargs)
+
+    # -- retry machinery ---------------------------------------------------
+
+    def _backoff_delay(self, attempt: int) -> float:
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2.0 ** (attempt - 1)))
+        if self.jitter:
+            # full-jitter downward: desynchronizes a worker herd
+            # reconnecting to a restarted PS, deterministic per seed
+            delay *= 1.0 - self.jitter * float(self._rng.random())
+        return delay
+
+    def _close_raw(self) -> None:
+        if self._raw is not None:
+            try:
+                self._raw.close()
+            except Exception:
+                pass
+            self._raw = None
+
+    def _op(self, op: Callable[[Any], Pytree]) -> Pytree:
+        attempt = 0
+        m = telemetry.metrics()
+        while True:
+            try:
+                if self._raw is None:
+                    self._raw = self._factory()
+                return op(self._raw)
+            except Exception as e:
+                # Exception, not BaseException: KeyboardInterrupt /
+                # MemoryError must not be retried
+                self._close_raw()
+                attempt += 1
+                self.retry_count += 1
+                m.counter("ps_client_retries_total").inc()
+                if attempt > self.retries:
+                    raise PSRetryExhausted(
+                        f"PS operation failed {attempt} time(s); "
+                        f"retry budget {self.retries} exhausted "
+                        f"(last: {e!r})") from e
+                if self.on_retry is not None:
+                    self.on_retry(attempt, e)
+                delay = self._backoff_delay(attempt)
+                m.histogram("ps_client_backoff_seconds").observe(delay)
+                time.sleep(delay)
+
+    # -- the client face ---------------------------------------------------
+
+    def pull(self) -> Pytree:
+        return self._op(lambda c: c.pull())
+
+    def commit(self, payload, local: Pytree | None = None) -> Pytree:
+        """At-most-once commit: the seq is stamped once and reused
+        across every internal retry (identical payload → the server
+        either applies it or returns the cached reply), advancing only
+        on success."""
+        seq = self._seq if self.use_seq else None
+        pulled = self._op(lambda c: c.commit(payload, local, seq=seq))
+        self._seq += 1
+        return pulled
+
+    def done(self) -> None:
+        """Courtesy clean-finish announcement (retires this worker from
+        server liveness monitoring); best-effort — a PS that is already
+        gone must not fail a worker that finished its work."""
+        if self._raw is not None:
+            try:
+                self._raw.done()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._close_raw()
+
+    def __enter__(self) -> "ResilientPSClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def stop_server(host: str, port: int):

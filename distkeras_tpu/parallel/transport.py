@@ -13,11 +13,23 @@ exchange is latency-bound request/response traffic).
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 
 _HEADER = struct.Struct(">Q")
-MAX_MSG_BYTES = 1 << 40  # sanity bound for the length header
+
+
+def _max_msg_bytes() -> int:
+    """Sanity bound for the length header: a corrupt/garbage header
+    must be rejected BEFORE ``recvall`` tries to allocate it.  Default
+    1 GB (a ResNet-scale PS payload is ~45 MB; anything near a
+    gigabyte is a desynced stream, not a parameter tree); override
+    with ``DKT_MAX_MSG_BYTES`` for genuinely larger models."""
+    return int(os.environ.get("DKT_MAX_MSG_BYTES", str(1 << 30)))
+
+
+MAX_MSG_BYTES = _max_msg_bytes()
 
 
 def determine_host_address() -> str:
@@ -34,16 +46,28 @@ def determine_host_address() -> str:
 
 def connect(host: str, port: int, timeout: float | None = None
             ) -> socket.socket:
+    """``timeout`` bounds connection ESTABLISHMENT only.  It is cleared
+    once connected: ``create_connection`` leaves the timeout armed on
+    the socket, so a pull slower than the connect timeout (big model,
+    busy PS) would raise ``socket.timeout`` MID-frame — desyncing the
+    length-prefix stream for every later message on the connection."""
     sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return sock
+
+
+def frame(*parts: bytes) -> bytes:
+    """One wire frame: 8-byte big-endian length header + body (exposed
+    so ``parallel.faults`` can truncate a real frame mid-wire)."""
+    total = sum(len(p) for p in parts)
+    return _HEADER.pack(total) + b"".join(parts)
 
 
 def send_msg(sock: socket.socket, *parts: bytes) -> None:
     """Send one framed message made of ``parts`` (concatenated headers
     let a request carry a command byte + payload without copies)."""
-    total = sum(len(p) for p in parts)
-    sock.sendall(_HEADER.pack(total) + b"".join(parts))
+    sock.sendall(frame(*parts))
 
 
 def _recvall(sock: socket.socket, n: int) -> bytes:
@@ -60,5 +84,9 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
 def recv_msg(sock: socket.socket) -> bytes:
     (length,) = _HEADER.unpack(_recvall(sock, _HEADER.size))
     if length > MAX_MSG_BYTES:
-        raise ValueError(f"message length {length} exceeds sanity bound")
+        # reject BEFORE allocating: a garbage header (desynced stream,
+        # hostile peer) must not drive a multi-terabyte recv loop
+        raise ValueError(
+            f"message length {length} exceeds sanity bound "
+            f"{MAX_MSG_BYTES} (DKT_MAX_MSG_BYTES)")
     return _recvall(sock, length)

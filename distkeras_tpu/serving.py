@@ -38,6 +38,17 @@ own cache rows).  Sampling draws from the engine's step/prefill key
 stream, so it is reproducible for a fixed seed and arrival order but
 NOT admission-order invariant.
 
+Graceful degradation (the fault-tolerance layer, docs/API.md "Fault
+tolerance"): ``queue_bound`` turns the admission queue into Orca-style
+load shedding (``submit`` raises ``ShedError`` + counts
+``serving_shed_total{reason}`` at the bound); per-request ``deadline``s
+expire queued AND live requests into ``error`` results instead of
+holding capacity; a poisoned request (failing prefill) errors out
+alone (``error`` result key, ``serving_request_errors_total``) without
+killing ``step()`` for its slot neighbors; ``drain()`` finishes the
+backlog and ``close()`` cancels what remains (every in-flight id comes
+back, ``error="engine_closed"``) and releases the device pools.
+
 Observability (``distkeras_tpu.telemetry``; no-op until
 ``telemetry.enable()``): per-bucket ``serving_queue_depth`` /
 ``serving_slot_occupancy`` gauges, ``serving_ttft_seconds`` /
@@ -64,15 +75,29 @@ from distkeras_tpu.models.generate import (_decode_model, _select,
 _UNSET = object()
 
 
+class ShedError(RuntimeError):
+    """``submit`` refused a request — admission-control load shedding
+    (Orca-style: reject at the door under overload instead of letting
+    the queue grow without bound).  ``reason`` is the machine-readable
+    cause (currently ``"queue_full"``); every shed also increments the
+    ``serving_shed_total{reason,bucket}`` counter.  The request never
+    entered the engine: resubmit after draining, or drop it."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason
+
+
 def _ceil_to(n: int, align: int) -> int:
     return -(-n // align) * align
 
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "meta",
-                 "submit_order", "t_submit", "t_first")
+                 "submit_order", "t_submit", "t_first", "deadline")
 
-    def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order):
+    def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order,
+                 deadline=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -82,6 +107,9 @@ class _Request:
         self.submit_order = submit_order
         self.t_submit = telemetry.now()
         self.t_first = None
+        # absolute telemetry.now() expiry (None: no deadline)
+        self.deadline = (None if deadline is None
+                         else self.t_submit + deadline)
 
 
 class _Pool:
@@ -135,6 +163,16 @@ class DecodeEngine:
       donate: donate cache/state buffers to the compiled programs so
         the pool is updated in place (default: on for non-CPU
         backends; CPU XLA cannot always honor it and warns).
+      queue_bound: bounded admission queue — per-bucket cap on WAITING
+        requests.  At the bound, ``submit`` sheds: it raises
+        ``ShedError(reason="queue_full")`` and counts
+        ``serving_shed_total`` instead of queueing without bound
+        (``None``: unbounded, the pre-fault-tolerance behavior).
+      deadline: default per-request wall-clock budget in seconds (from
+        submit; ``submit(deadline=...)`` overrides per request).  A
+        request past its deadline — still queued or mid-decode — is
+        finished with an ``error`` result instead of holding a slot
+        or queue position (``None``: no deadline).
     """
 
     def __init__(self, model, variables: Mapping, *, slots: int = 8,
@@ -143,7 +181,9 @@ class DecodeEngine:
                  prefill_align: int = 128, steps_per_sync: int = 1,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 queue_bound: Optional[int] = None,
+                 deadline: Optional[float] = None):
         base = _decode_model(model)
         self.max_len = base.max_len
         self.vocab_size = base.vocab_size
@@ -167,6 +207,13 @@ class DecodeEngine:
                 f"top_k={top_k} out of range [1, {base.vocab_size}]")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p={top_p} out of range (0, 1]")
+        if queue_bound is not None and queue_bound < 1:
+            raise ValueError(
+                f"queue_bound must be >= 1 (or None); got {queue_bound}")
+        if deadline is not None and deadline <= 0:
+            raise ValueError(
+                f"deadline must be positive seconds (or None); got "
+                f"{deadline}")
         if buckets is None:
             buckets = {self.max_len: slots}
         elif isinstance(buckets, Mapping):
@@ -192,9 +239,13 @@ class DecodeEngine:
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
+        self.queue_bound = queue_bound
+        self.deadline = deadline
         self._key = jax.random.key(seed)
         self._n_rng = 0
         self._n_submitted = 0
+        self._inflight: set = set()  # rids queued or in a slot
+        self._closed = False
         self._traces: collections.Counter = collections.Counter()
         if donate is None:
             donate = jax.default_backend() != "cpu"
@@ -327,14 +378,20 @@ class DecodeEngine:
             f"{[p.env for p in self._pools]}, max_len={self.max_len})")
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
-               eos_id=_UNSET, request_id=None,
+               eos_id=_UNSET, request_id=None, deadline=_UNSET,
                meta: Optional[Mapping] = None):
         """Queue one request; returns its id (auto-assigned if None).
 
-        ``max_new_tokens``/``eos_id`` default to the engine's; the
-        request fails HERE if it fits no bucket, never inside a later
-        compiled flush.
+        ``max_new_tokens``/``eos_id``/``deadline`` default to the
+        engine's; the request fails HERE if it fits no bucket, never
+        inside a later compiled flush.  A ``request_id`` equal to one
+        still in flight is rejected (results would cross-deliver);
+        auto-assigned ids skip over in-flight explicit ids.  With
+        ``queue_bound`` set, a full admission queue sheds the request
+        (``ShedError``) instead of accepting it.
         """
+        if self._closed:
+            raise RuntimeError("engine is closed; submit after close()")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or len(prompt) < 1:
             raise ValueError(
@@ -350,13 +407,37 @@ class DecodeEngine:
         if eos is not None and not 0 <= eos < self.vocab_size:
             raise ValueError(
                 f"eos_id={eos} outside vocab [0, {self.vocab_size})")
+        dl = self.deadline if deadline is _UNSET else deadline
+        if dl is not None and dl <= 0:
+            raise ValueError(
+                f"deadline must be positive seconds (or None); got "
+                f"{dl}")
         pool = self._route(len(prompt), max_new)
-        rid = self._n_submitted if request_id is None else request_id
-        req = _Request(rid, prompt, int(max_new), eos,
-                       dict(meta or {}), self._n_submitted)
-        self._n_submitted += 1
-        pool.queue.append(req)
         m = telemetry.metrics()
+        if (self.queue_bound is not None
+                and len(pool.queue) >= self.queue_bound):
+            m.counter("serving_shed_total", reason="queue_full",
+                      bucket=pool.env).inc()
+            raise ShedError(
+                "queue_full",
+                f"bucket {pool.env} admission queue at its bound "
+                f"({self.queue_bound} waiting); request shed — "
+                "resubmit after draining")
+        if request_id is None:
+            rid = self._n_submitted
+            while rid in self._inflight:  # skip in-flight explicit ids
+                rid += 1
+        else:
+            rid = request_id
+            if rid in self._inflight:
+                raise ValueError(
+                    f"request_id {rid!r} is already in flight; "
+                    "duplicate ids would cross-deliver results")
+        req = _Request(rid, prompt, int(max_new), eos,
+                       dict(meta or {}), self._n_submitted, deadline=dl)
+        self._n_submitted += 1
+        self._inflight.add(rid)
+        pool.queue.append(req)
         m.counter("serving_requests_total", bucket=pool.env).inc()
         m.gauge("serving_queue_depth",
                 bucket=pool.env).set(len(pool.queue))
@@ -386,10 +467,32 @@ class DecodeEngine:
         m.gauge("serving_slot_occupancy", bucket=pool.env).set(
             sum(r is not None for r in pool.reqs))
 
+    def _shed_expired_queued(self, pool: _Pool) -> list[dict]:
+        """Sweep the admission queue for requests already past their
+        deadline — they leave with an ``error`` result instead of
+        consuming a prefill + slot they can no longer use."""
+        if not any(r.deadline is not None for r in pool.queue):
+            return []
+        now = telemetry.now()
+        expired, alive = [], collections.deque()
+        for req in pool.queue:
+            (expired if req.deadline is not None and now > req.deadline
+             else alive).append(req)
+        pool.queue = alive
+        m = telemetry.metrics()
+        out = []
+        for req in expired:
+            m.counter("serving_shed_total", reason="deadline",
+                      bucket=pool.env).inc()
+            out.append(self._finish_error(req, "deadline_exceeded",
+                                          pool.env))
+        return out
+
     def _admit(self) -> list[dict]:
         finished = []
         m = telemetry.metrics()
         for pool in self._pools:
+            finished.extend(self._shed_expired_queued(pool))
             for slot in range(pool.n_slots):
                 if not pool.queue:
                     break
@@ -401,16 +504,29 @@ class DecodeEngine:
                             _ceil_to(t_p, self.prefill_align))
                 padded = np.full((1, t_pad), self.pad_id, np.int32)
                 padded[0, :t_p] = req.prompt
-                with telemetry.span("prefill", bucket=pool.env,
-                                    slot=slot, padded=t_pad,
-                                    request_id=req.rid):
-                    pool.cache, pool.state, tok0 = pool.prefill_fn(
-                        self.variables, pool.cache, pool.state,
-                        jnp.asarray(padded), slot, t_p - 1,
-                        req.max_new - 1,
-                        -1 if req.eos_id is None else req.eos_id,
-                        self._next_rng())
-                    req.tokens.append(int(tok0))
+                try:
+                    with telemetry.span("prefill", bucket=pool.env,
+                                        slot=slot, padded=t_pad,
+                                        request_id=req.rid):
+                        pool.cache, pool.state, tok0 = pool.prefill_fn(
+                            self.variables, pool.cache, pool.state,
+                            jnp.asarray(padded), slot, t_p - 1,
+                            req.max_new - 1,
+                            -1 if req.eos_id is None else req.eos_id,
+                            self._next_rng())
+                        req.tokens.append(int(tok0))
+                except Exception as e:
+                    # Per-request error isolation: a poisoned request
+                    # is finished with an ``error`` result — its slot
+                    # stays free and its neighbors keep decoding —
+                    # instead of the exception killing step() for
+                    # every slot.  (With buffer donation on, a failure
+                    # DURING execution can still poison the pool;
+                    # trace-/dispatch-time failures, the common case,
+                    # are fully isolated.)
+                    finished.append(self._finish_error(
+                        req, f"prefill_failed: {e!r}", pool.env))
+                    continue
                 req.t_first = telemetry.now()
                 m.counter("serving_tokens_total",
                           bucket=pool.env).inc()
@@ -441,6 +557,7 @@ class DecodeEngine:
         ``request_id`` surviving."""
         req = pool.reqs[slot]
         pool.reqs[slot] = None
+        self._inflight.discard(req.rid)
         t_finish = telemetry.now()
         ttft = req.t_first - req.t_submit
         latency = t_finish - req.t_submit
@@ -457,6 +574,29 @@ class DecodeEngine:
                 "t_finish": t_finish, "ttft": ttft,
                 "latency": latency}
 
+    def _finish_error(self, req: _Request, error: str,
+                      env: int) -> dict:
+        """Terminal ERROR result: same shape as ``_finish``'s dict plus
+        an ``error`` key (never present on success); ``tokens`` holds
+        whatever was generated before the failure, ``ttft`` is None for
+        a request that never produced a token.  The request has already
+        left its queue/slot."""
+        self._inflight.discard(req.rid)
+        t_finish = telemetry.now()
+        m = telemetry.metrics()
+        m.counter("serving_request_errors_total", bucket=env).inc()
+        telemetry.instant("request_error", bucket=env,
+                          request_id=req.rid, error=error)
+        ttft = (None if req.t_first is None
+                else req.t_first - req.t_submit)
+        return {**req.meta,
+                "request_id": req.rid, "prompt": req.prompt,
+                "tokens": np.asarray(req.tokens, np.int32),
+                "error": error,
+                "t_submit": req.t_submit, "t_first": req.t_first,
+                "t_finish": t_finish, "ttft": ttft,
+                "latency": t_finish - req.t_submit}
+
     # ---- serving loop -------------------------------------------------
 
     def has_work(self) -> bool:
@@ -465,7 +605,12 @@ class DecodeEngine:
     def step(self) -> list[dict]:
         """Admit waiting requests into free slots, advance every live
         bucket by ``steps_per_sync`` tokens, evict newly finished
-        requests and return their results (as-completed order)."""
+        requests and return their results (as-completed order).
+        Deadline-expired requests (queued or live) come back as
+        ``error`` results; a poisoned request errors out alone without
+        stalling its neighbors' slots."""
+        if self._closed:
+            raise RuntimeError("engine is closed; step after close()")
         finished = self._admit()
         m = telemetry.metrics()
         for pool in self._pools:
@@ -496,9 +641,64 @@ class DecodeEngine:
             if n_tok:
                 m.counter("serving_tokens_total",
                           bucket=pool.env).inc(n_tok)
+            # live requests past their deadline free the slot NOW —
+            # graceful degradation under a stuck/slow decode rather
+            # than holding capacity for an answer nobody will take
+            now = telemetry.now()
+            for slot, req in enumerate(pool.reqs):
+                if (req is not None and req.deadline is not None
+                        and now > req.deadline):
+                    pool.reqs[slot] = None
+                    m.counter("serving_shed_total", reason="deadline",
+                              bucket=pool.env).inc()
+                    telemetry.instant("evict", bucket=pool.env,
+                                      slot=slot, request_id=req.rid)
+                    finished.append(self._finish_error(
+                        req, "deadline_exceeded", pool.env))
             self._note_gauges(pool)
         finished.extend(self._admit())
         return finished
+
+    # ---- graceful shutdown --------------------------------------------
+
+    def drain(self) -> list[dict]:
+        """Serve everything in flight to completion and return ALL
+        results (as-completed order) — queued requests included.  The
+        graceful half of shutdown: ``drain()`` then ``close()``."""
+        out = []
+        while self.has_work():
+            out.extend(self.step())
+        return out
+
+    def close(self) -> list[dict]:
+        """Shut the engine down: requests still queued or mid-decode
+        are CANCELLED (returned as ``error="engine_closed"`` results —
+        every in-flight id is accounted for, nothing vanishes), the
+        device cache pools are released, and further ``submit``/
+        ``step`` calls raise.  Call ``drain()`` first for a graceful
+        shutdown that finishes the backlog instead."""
+        if self._closed:
+            return []
+        out = []
+        for pool in self._pools:
+            while pool.queue:
+                out.append(self._finish_error(
+                    pool.queue.popleft(), "engine_closed", pool.env))
+            for slot, req in enumerate(pool.reqs):
+                if req is not None:
+                    pool.reqs[slot] = None
+                    out.append(self._finish_error(
+                        req, "engine_closed", pool.env))
+            pool.cache = pool.state = None  # release the device pool
+            self._note_gauges(pool)
+        self._closed = True
+        return out
+
+    def __enter__(self) -> "DecodeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def run(self, requests: Iterable, *, ordered: bool = True
             ) -> Iterator[dict]:

@@ -135,9 +135,14 @@ class StreamingGenerator:
     (``pad_id`` after ``eos_id``), and greedy results are identical to
     the bucketed mode.  ``engine_options`` passes through
     ``DecodeEngine`` knobs (``buckets``, ``steps_per_sync``,
-    ``prefill_align``, ``slots``...); ``num_beams > 1`` stays
-    bucketed-only.  ``flush_every`` is ignored: admission is
-    per-request, so no bucket can starve a minority length.
+    ``prefill_align``, ``slots``, ``queue_bound``, ``deadline``...);
+    ``num_beams > 1`` stays bucketed-only.  ``flush_every`` is
+    ignored: admission is per-request, so no bucket can starve a
+    minority length.  Fault tolerance: a ``queue_bound`` engine's
+    sheds become BACKPRESSURE inside the stream (the producer loop
+    steps and resubmits), and an engine-side failure (deadline,
+    poisoned request) surfaces as a ``"{output_col}_error"`` key on
+    that row — tokens-so-far padded — instead of killing the stream.
     """
 
     def __init__(self, model, variables: Mapping, *,
@@ -291,7 +296,15 @@ class StreamingGenerator:
             out = np.full((self.max_new_tokens,), self.pad_id,
                           np.int32)
             out[:len(res["tokens"])] = res["tokens"]
-            return {**row, self.output_col: out}
+            rec = {**row, self.output_col: out}
+            if "error" in res:
+                # engine-side failure (deadline / poisoned request):
+                # the row still flows — padded tokens-so-far plus the
+                # reason — rather than one bad row killing the stream
+                rec[f"{self.output_col}_error"] = res["error"]
+            return rec
+
+        from distkeras_tpu.serving import ShedError
 
         for i, row in enumerate(rows):
             prompt = np.asarray(row[self.prompt_col])
@@ -299,10 +312,18 @@ class StreamingGenerator:
                 raise ValueError(
                     f"stream row {i}: prompt must be a 1-D token-id "
                     f"array; got shape {prompt.shape}")
-            try:
-                eng.submit(prompt, request_id=i)
-            except ValueError as e:
-                raise ValueError(f"stream row {i}: {e}") from e
+            while True:
+                try:
+                    eng.submit(prompt, request_id=i)
+                    break
+                except ShedError:
+                    # a queue_bound engine sheds at the door; the
+                    # stream is a bounded producer, so convert the
+                    # shed into BACKPRESSURE — drain a step and retry
+                    for res in eng.step():
+                        done[res["request_id"]] = pad_out(res)
+                except ValueError as e:
+                    raise ValueError(f"stream row {i}: {e}") from e
             rows_by_id[i] = row
             # step while the slot pools are saturated (a queue is only
             # non-empty when every fitting slot is occupied)
@@ -312,9 +333,8 @@ class StreamingGenerator:
             while next_emit in done:       # restore input order
                 yield done.pop(next_emit)
                 next_emit += 1
-        while eng.has_work():
-            for res in eng.step():
-                done[res["request_id"]] = pad_out(res)
+        for res in eng.drain():            # graceful tail
+            done[res["request_id"]] = pad_out(res)
         while next_emit in done:
             yield done.pop(next_emit)
             next_emit += 1

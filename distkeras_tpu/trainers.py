@@ -788,7 +788,10 @@ class DistributedTrainer(Trainer):
                  fault_injector=None, compression=None,
                  model_parallel: int = 1, tp_rules=None,
                  lr_law: str = "warn",
-                 commit_overlap: bool = False, **kwargs):
+                 commit_overlap: bool = False,
+                 ps_address: tuple[str, int] | None = None,
+                 ps_snapshot_path: str | None = None,
+                 ps_snapshot_every: int = 0, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -819,7 +822,29 @@ class DistributedTrainer(Trainer):
         the PS center shards by the TP specs alone, and GSPMD derives
         both the TP collectives inside each worker and the commit
         reduction across workers; for PS-family models too big for one
-        chip (beyond the reference, which was DP-only)."""
+        chip (beyond the reference, which was DP-only).
+
+        Fault tolerance (host arm; docs/API.md "Fault tolerance"):
+        network-level failures — connects, pulls, commits — are
+        retried INSIDE ``parallel.host_ps.ResilientPSClient`` with
+        exponential backoff + jitter and at-most-once commit seqs (a
+        commit whose ack was lost is deduped server-side, never
+        applied twice); compute-level failures (``fault_injector``, a
+        poisoned window) re-pull and re-run the window here.  Both
+        budgets are ``worker_retries`` and both record
+        ``history['worker_round_retries']``.
+        ``ps_snapshot_path`` + ``ps_snapshot_every=N`` (socket/
+        in-process host arm) write a warm-restart PS snapshot every N
+        commits — ``PSServer.restart_from`` brings a killed server
+        back and reconnecting workers resume without double-applying
+        (``history['ps_snapshots']`` counts the writes).
+        ``ps_address=(host, port)`` attaches to an EXTERNALLY managed
+        ``PSServer`` instead of creating one: the PS outlives this
+        driver (the reference's driver-death=job-death hole,
+        SURVEY.md §5), and an operator can kill/warm-restart it
+        mid-run; requires ``transport='socket'`` (the server's rule
+        must match this trainer's; staleness history stays
+        server-side)."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -849,17 +874,39 @@ class DistributedTrainer(Trainer):
         if self.worker_timeout is not None and self.worker_timeout <= 0:
             raise ValueError(
                 f"worker_timeout must be positive, got {worker_timeout}")
+        self.ps_address = (None if ps_address is None
+                           else (str(ps_address[0]),
+                                 int(ps_address[1])))
+        self.ps_snapshot_path = ps_snapshot_path
+        self.ps_snapshot_every = int(ps_snapshot_every)
         if fidelity != "host" and (self.max_worker_failures
                                    or self.worker_retries
                                    or self.worker_timeout is not None
                                    or fault_injector is not None
-                                   or compression is not None):
+                                   or compression is not None
+                                   or ps_address is not None
+                                   or ps_snapshot_path is not None
+                                   or self.ps_snapshot_every):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
-                "/ fault_injector / compression apply only to "
+                "/ fault_injector / compression / ps_address / "
+                "ps_snapshot_* apply only to "
                 "fidelity='host' (the emulated arms are deterministic; "
                 "recover via checkpoint/resume), got "
                 f"fidelity={fidelity!r}")
+        if ps_address is not None and transport != "socket":
+            raise ValueError(
+                "ps_address attaches to an external PSServer over TCP; "
+                f"it requires transport='socket', got {transport!r}")
+        if self.ps_snapshot_every and ps_snapshot_path is None:
+            raise ValueError(
+                "ps_snapshot_every needs ps_snapshot_path to write to")
+        if ps_address is not None and (ps_snapshot_path is not None
+                                       or self.ps_snapshot_every):
+            raise ValueError(
+                "with an external ps_address, configure snapshotting "
+                "on the externally created HostParameterServer, not "
+                "on the trainer (the driver does not own the server)")
         self.commit_overlap = bool(commit_overlap)
         if self.commit_overlap and fidelity != "faithful":
             raise ValueError(
@@ -1399,7 +1446,8 @@ class DistributedTrainer(Trainer):
         from distkeras_tpu.parallel.compression import (raw_nbytes,
                                                         resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
-            HostParameterServer, PSClient, PSServer)
+            HostParameterServer, PSClient, PSRetryExhausted, PSServer,
+            ResilientPSClient)
         from distkeras_tpu.utils import (tree_add, tree_sub,
                                          tree_zeros_like)
 
@@ -1436,11 +1484,17 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     f"multi-host needs num_workers ({num_workers}) "
                     f"divisible by the process count ({pc})")
+            if self.ps_address is not None:
+                raise ValueError(
+                    "external ps_address does not compose with "
+                    "multi-host runs (process 0 hosts the PS there)")
 
         ps = None
         server = None
-        if not multi or rank == 0:
-            ps = HostParameterServer(rule, center)
+        if self.ps_address is None and (not multi or rank == 0):
+            ps = HostParameterServer(
+                rule, center, snapshot_path=self.ps_snapshot_path,
+                snapshot_every=self.ps_snapshot_every)
             if self.transport == "socket":
                 server = PSServer(
                     ps, center,
@@ -1471,6 +1525,8 @@ class DistributedTrainer(Trainer):
             host_s, _, port_s = bytes(
                 wire).rstrip(b"\0").decode().rpartition(":")
             ps_address = (host_s, int(port_s))
+        elif self.ps_address is not None:
+            ps_address = self.ps_address  # externally managed PSServer
         else:
             ps_address = server.address if server is not None else None
 
@@ -1619,42 +1675,41 @@ class DistributedTrainer(Trainer):
                 _sweep_shard_cache()
 
         def worker_loop(w: int):
-            client = None
+            # (epoch, round) the retry callback stamps; -1 = startup.
+            # Network-level failures (connect/pull/commit) are retried
+            # INSIDE ResilientPSClient — backoff + jitter + at-most-once
+            # commit seqs; this loop keeps only the COMPUTE-level
+            # budget (fault_injector, a poisoned window).
+            round_ctx = [-1, -1]
 
-            def connect():
-                nonlocal client
-                if ps_address is not None:
-                    client = PSClient(*ps_address, worker_id=w,
-                                      template=center, codec=codec)
-                    return client.pull, client.commit
-                # In-process commits are atomic (apply-and-return under
-                # the lock — no lost-ack window), so no dedupe seq.
-                return (lambda: ps.pull(w),
-                        lambda p, l=None, seq=None: ps.commit(w, p, l))
+            def on_retry(attempt, exc):
+                retry_records.append((w, round_ctx[0], round_ctx[1]))
+                telemetry.instant("worker_retry", worker=w,
+                                  epoch=round_ctx[0],
+                                  round=round_ctx[1])
 
+            retry_kw = dict(retries=self.worker_retries,
+                            seed=self.seed + 101 * w,
+                            on_retry=on_retry)
+            socket_arm = ps_address is not None
+            if socket_arm:
+                client = ResilientPSClient.for_address(
+                    *ps_address, worker_id=w, template=center,
+                    codec=codec, **retry_kw)
+            else:
+                client = ResilientPSClient.for_server(ps, w,
+                                                      **retry_kw)
             wire_bytes = raw_bytes = 0
             try:
-                commit_seq = 0
                 state = TrainState.create(
                     {"params": center, **model_state}, tx,
                     worker_keys[w])
                 residual = (tree_zeros_like(center)
                             if codec is not None else None)
-                attempts = 0
-                while True:  # startup contact, same retry budget
-                    try:
-                        pull, commit = connect()
-                        pulled = pull()
-                        break
-                    except Exception:
-                        attempts += 1
-                        if attempts > self.worker_retries:
-                            raise
-                        if client is not None:
-                            client.close()
-                        retry_records.append((w, -1, -1))
-                        telemetry.instant("worker_retry", worker=w,
-                                          phase="startup")
+                # startup contact rides the same budget as any later
+                # op (the client builds its connection lazily inside
+                # the retry loop)
+                pulled = client.pull()
                 for epoch in range(self.num_epoch):
                     epoch_rounds = 0  # global round id across segments
                     for slot in range(len(epoch_plan(epoch))):
@@ -1689,98 +1744,85 @@ class DistributedTrainer(Trainer):
                                     v[r_local * window:
                                       (r_local + 1) * window])
                                 for k, v in stacked.items()}
-                            attempts = 0
-                            reconnect = False
-                            # (bytes, applied, total, raw_nbytes) cached
-                            # across retry attempts of this commit_seq
-                            pending_commit = None
+                            round_ctx[0], round_ctx[1] = epoch, r
+                            attempts = 0  # compute-level retry budget
                             base_state = state  # pre-round snapshot: a
                             # retried window must not see optimizer
                             # moments / rng / step already advanced by the
                             # aborted attempt
                             while True:
                                 try:
-                                    if reconnect:
-                                        # inside the try: a PS still
-                                        # unreachable during recovery must
-                                        # consume retry budget, not kill
-                                        # the worker outright
-                                        if client is not None:
-                                            client.close()
-                                        pull, commit = connect()
-                                        pulled = pull()
-                                        reconnect = False
                                     if self.fault_injector is not None:
                                         self.fault_injector(w, epoch, r)
-                                    if pending_commit is None:
-                                        start_params = (
-                                            jax.tree_util.tree_map(
-                                                jnp.asarray, pulled))
-                                        state = base_state.replace(
-                                            params=start_params)
-                                        state, metrics = run_window(
-                                            state, batches)
-                                        if rule.payload_kind == "params":
-                                            payload = local = state.params
-                                        else:
-                                            payload = rule.normalize_delta(
-                                                tree_sub(state.params,
-                                                         start_params),
-                                                window)
-                                            local = None
-                                        if codec is not None:
-                                            # Error feedback: fold the
-                                            # residual under-transmitted so
-                                            # far into this window's delta;
-                                            # cache the encoding per
-                                            # commit_seq.
-                                            total = tree_add(payload,
-                                                             residual)
-                                            pending_commit = (
-                                                *codec.round_trip(total),
-                                                total, raw_nbytes(payload))
-                                    # A retry with a cached encoding skips
-                                    # the window recompute and resends the
-                                    # IDENTICAL bytes: the server may have
-                                    # applied them and lost only the ack
-                                    # (seq dedupe returns the cached
-                                    # reply), so the residual below always
-                                    # matches what the server absorbed.
-                                    if codec is not None:
-                                        encoded, applied, total, raw_n = (
-                                            pending_commit)
-                                        pulled = commit(
-                                            encoded if client is not None
-                                            else applied,
-                                            None, seq=commit_seq)
-                                        residual = tree_sub(total, applied)
-                                        pending_commit = None
-                                        wire_bytes += len(encoded)
-                                        raw_bytes += raw_n
+                                    start_params = (
+                                        jax.tree_util.tree_map(
+                                            jnp.asarray, pulled))
+                                    state = base_state.replace(
+                                        params=start_params)
+                                    state, metrics = run_window(
+                                        state, batches)
+                                    if rule.payload_kind == "params":
+                                        payload = local = state.params
                                     else:
-                                        pulled = commit(
+                                        payload = rule.normalize_delta(
+                                            tree_sub(state.params,
+                                                     start_params),
+                                            window)
+                                        local = None
+                                    if codec is not None:
+                                        # Error feedback: fold the
+                                        # residual under-transmitted so
+                                        # far into this window's delta.
+                                        # The client retries internally
+                                        # with these IDENTICAL bytes
+                                        # under ONE commit seq, so a
+                                        # lost-ack retry dedupes
+                                        # server-side and the residual
+                                        # always matches what the
+                                        # server absorbed.
+                                        total = tree_add(payload,
+                                                         residual)
+                                        encoded, applied = (
+                                            codec.round_trip(total))
+                                        pulled = client.commit(
+                                            encoded if socket_arm
+                                            else applied, None)
+                                        residual = tree_sub(total,
+                                                            applied)
+                                        wire_bytes += len(encoded)
+                                        raw_bytes += raw_nbytes(
+                                            payload)
+                                    else:
+                                        pulled = client.commit(
                                             payload,
-                                            local if rule.pull_uses_local
-                                            else None, seq=commit_seq)
-                                    commit_seq += 1
+                                            local
+                                            if rule.pull_uses_local
+                                            else None)
                                     break
+                                except PSRetryExhausted:
+                                    # the network budget died inside
+                                    # the client; recomputing the
+                                    # window cannot revive the link
+                                    raise
                                 except Exception:
-                                    # At-most-once retry: an uncommitted
-                                    # window's delta never reached the PS;
-                                    # one whose *ack* was lost is deduped
-                                    # server-side by commit_seq.
-                                    # (Exception, not BaseException:
-                                    # KeyboardInterrupt/MemoryError should
-                                    # not be retried.)
+                                    # Compute-level failure (chaos
+                                    # hook, poisoned window): re-pull
+                                    # and re-run on this loop's own
+                                    # budget.  At-most-once holds: an
+                                    # uncommitted window's delta never
+                                    # reached the PS.  (Exception, not
+                                    # BaseException: KeyboardInterrupt
+                                    # / MemoryError should not be
+                                    # retried.)
                                     attempts += 1
                                     if attempts > self.worker_retries:
                                         raise
-                                    reconnect = True
                                     retry_records.append((w, epoch, r))
                                     telemetry.instant("worker_retry",
                                                       worker=w,
                                                       epoch=epoch,
                                                       round=r)
+                                    pulled = client.pull()
                             round_records.append(
                                 (w, epoch,
                                  float(np.mean(
@@ -1796,11 +1838,8 @@ class DistributedTrainer(Trainer):
                             f"worker {w}: not enough batches per "
                             f"worker for one communication window "
                             f"({window}) in any segment")
-                if client is not None:
-                    client.done()
-                    client.close()
-                else:
-                    ps.retire(w)
+                client.done()
+                client.close()
             except BaseException as e:  # handled by the join below
                 note_death(w)
                 failures.append((w, e))
@@ -1885,6 +1924,8 @@ class DistributedTrainer(Trainer):
                                           for w, e in failures])
         if retry_records:
             self._record(worker_round_retries=retry_records)
+        if ps is not None and ps.num_snapshots:
+            self._record(ps_snapshots=ps.num_snapshots)
         if codec is not None:
             self._record(commit_wire_bytes=int(wire_total.value),
                          commit_raw_bytes=int(raw_total.value))
@@ -1927,10 +1968,22 @@ class DistributedTrainer(Trainer):
                 jax.tree_util.tree_map(
                     np.asarray, ps.center if ps is not None else center),
                 is_source=rank == 0)
-        else:
+        elif ps is not None:
             self._record(staleness=list(ps.staleness_log))
             final_center = ps.center
-        self.parameter_server_state = ps  # None off process 0
+        else:
+            # external ps_address: the final center is pulled over the
+            # wire; staleness history stays server-side (the PS
+            # outlives this driver — the ps_address contract)
+            fin = PSClient(*self.ps_address, worker_id=num_workers,
+                           template=center)
+            try:
+                final_center = fin.pull()
+                fin.done()
+            finally:
+                fin.close()
+        self.parameter_server_state = ps  # None off process 0 and
+        # for external ps_address (the server owns its state there)
         self.trained_variables = {
             "params": jax.tree_util.tree_map(jnp.asarray, final_center),
             **model_state}

@@ -35,6 +35,28 @@ def test_save_load_roundtrip_with_prng_keys(tmp_path):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_ps_snapshot_template_free_roundtrip(tmp_path):
+    """``save_ps_snapshot``/``load_ps_snapshot`` restore WITHOUT a
+    template (a warm-restarting PS has none — its state died with the
+    process): the self-describing msgpack encoding carries nested
+    trees, scalars, and dtypes, and the tmp+rename write is atomic
+    (no .tmp litter)."""
+    from distkeras_tpu.checkpoint import (load_ps_snapshot,
+                                          save_ps_snapshot)
+
+    snap = {"center": {"layer": {"w": np.arange(6, dtype=np.float32)}},
+            "clock": 7,
+            "seqs": {"0": np.uint64(2 ** 63)}}
+    path = tmp_path / "ps.snap"
+    save_ps_snapshot(path, snap)
+    assert not list(tmp_path.glob("*.tmp"))
+    loaded = load_ps_snapshot(path)
+    np.testing.assert_array_equal(loaded["center"]["layer"]["w"],
+                                  snap["center"]["layer"]["w"])
+    assert int(loaded["clock"]) == 7
+    assert int(loaded["seqs"]["0"]) == 2 ** 63
+
+
 def test_single_trainer_kill_and_resume_bitwise(tmp_path):
     kwargs = dict(worker_optimizer="adam", learning_rate=3e-3,
                   batch_size=64, num_epoch=3, seed=1)

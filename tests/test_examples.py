@@ -24,6 +24,7 @@ SCRIPTS = REPO / "scripts"
 # perf/measurement scripts that advertise a --smoke mode run it here
 # at tiny CPU shapes — the same no-silent-rot contract as CASES.
 SMOKE_SCRIPTS = {
+    "chaos_report.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],

@@ -76,7 +76,8 @@ def _collect_emitted() -> set[str]:
 
     # host arm chaos paths in one run: a transient failure (retry), a
     # hard failure (tolerated death), a stall (watchdog detection),
-    # and wire compression (byte totals)
+    # wire compression (byte totals), and periodic PS warm-restart
+    # snapshots (the fault-tolerance key)
     state = {"transient": True, "stall": True}
 
     def injector(w, epoch, r):
@@ -87,12 +88,15 @@ def _collect_emitted() -> set[str]:
         if w == 2 and r == 1 and state.pop("stall", False):
             time.sleep(1.2)
 
-    run(DOWNPOUR(MLP, fidelity="host", num_workers=3,
-                 communication_window=2, batch_size=16, num_epoch=1,
-                 learning_rate=0.01, worker_optimizer="adam",
-                 worker_retries=1, max_worker_failures=1,
-                 worker_timeout=0.3, fault_injector=injector,
-                 compression="int8"))
+    with tempfile.TemporaryDirectory() as d:
+        run(DOWNPOUR(MLP, fidelity="host", num_workers=3,
+                     communication_window=2, batch_size=16, num_epoch=1,
+                     learning_rate=0.01, worker_optimizer="adam",
+                     worker_retries=1, max_worker_failures=1,
+                     worker_timeout=0.3, fault_injector=injector,
+                     compression="int8",
+                     ps_snapshot_path=f"{d}/ps.snap",
+                     ps_snapshot_every=4))
     return emitted
 
 
@@ -109,7 +113,7 @@ def test_every_emitted_history_key_is_documented():
             "segment_stall_s", "dropped_tail_batches",
             "skipped_segment_rows", "eval_accuracy", "member_loss",
             "worker_failures", "worker_round_retries",
-            "commit_wire_bytes", "commit_raw_bytes"}
+            "commit_wire_bytes", "commit_raw_bytes", "ps_snapshots"}
     missing = core - emitted
     assert not missing, (
         f"collection no longer exercises core history keys: "

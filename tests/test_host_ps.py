@@ -1,9 +1,14 @@
 """Host-side concurrent PS (design 5a) — transport framing, serial
 equivalence against the emulator's scan path, convergence of the
-threaded faithful arm, and the socket protocol end to end."""
+threaded faithful arm, the socket protocol end to end, and the
+fault-tolerance layer: resilient client retry/backoff/dedupe, PS
+snapshot + warm restart, and the kill-and-restart-mid-training
+integration (docs/API.md "Fault tolerance")."""
 
 import socket
+import struct
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -17,7 +22,9 @@ from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.host_ps import (
     HostParameterServer,
     PSClient,
+    PSRetryExhausted,
     PSServer,
+    ResilientPSClient,
 )
 from distkeras_tpu.parallel.update_rules import (
     AdagRule,
@@ -357,3 +364,301 @@ def test_worker_timeout_host_only_and_positive():
         DOWNPOUR(MLP, worker_timeout=5.0)
     with pytest.raises(ValueError, match="positive"):
         DOWNPOUR(MLP, fidelity="host", worker_timeout=0.0)
+
+
+# ---- fault-tolerance layer (ISSUE 3) ---------------------------------
+
+
+def test_connect_clears_timeout_and_survives_slow_replies():
+    """Regression (ISSUE 3 satellite): ``transport.connect`` used to
+    leave the connect timeout armed on the socket, so any reply slower
+    than it raised ``socket.timeout`` MID-frame and desynced the
+    length-prefix stream.  Now the timeout bounds establishment only —
+    a reply slower than the connect timeout still arrives whole."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen()
+
+    def slow_echo():
+        conn, _ = srv.accept()
+        with conn:
+            time.sleep(0.6)  # slower than the connect timeout below
+            transport.send_msg(conn, b"x" * 100_000)
+
+    t = threading.Thread(target=slow_echo, daemon=True)
+    t.start()
+    try:
+        sock = transport.connect(*srv.getsockname(), timeout=0.25)
+        assert sock.gettimeout() is None  # cleared after establishment
+        assert transport.recv_msg(sock) == b"x" * 100_000
+        sock.close()
+    finally:
+        t.join()
+        srv.close()
+
+
+def test_oversized_length_header_rejected_before_allocation(monkeypatch):
+    """A garbage/hostile length header is rejected by the sanity bound
+    BEFORE ``_recvall`` allocates; the bound is env-configurable
+    (``DKT_MAX_MSG_BYTES``, default 1 GB — down from the old 1 TB)."""
+    monkeypatch.setattr(transport, "MAX_MSG_BYTES", 1 << 20)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">Q", 1 << 50))  # desynced-stream header
+        with pytest.raises(ValueError, match="sanity bound"):
+            transport.recv_msg(b)
+        # at the bound is fine; one past it is not
+        assert transport.MAX_MSG_BYTES == 1 << 20
+    finally:
+        a.close()
+        b.close()
+    monkeypatch.setenv("DKT_MAX_MSG_BYTES", "12345")
+    assert transport._max_msg_bytes() == 12345
+    monkeypatch.delenv("DKT_MAX_MSG_BYTES")
+    assert transport._max_msg_bytes() == 1 << 30
+
+
+class _AlwaysFail:
+    def pull(self):
+        raise ConnectionError("dead PS")
+
+    def close(self):
+        pass
+
+
+def test_resilient_client_retry_budget_and_deterministic_backoff():
+    """The extracted retry core: transient failures are retried with
+    rebuilt connections; the budget exhausts into ``PSRetryExhausted``
+    (cause preserved); jittered backoff is deterministic per seed;
+    KeyboardInterrupt is never retried."""
+    calls = {"n": 0, "built": 0}
+
+    class Flaky:
+        def pull(self):
+            if calls["n"] < 2:
+                calls["n"] += 1
+                raise ConnectionError("transient")
+            return {"ok": 1}
+
+        def close(self):
+            pass
+
+    def factory():
+        calls["built"] += 1
+        return Flaky()
+
+    c = ResilientPSClient(factory, retries=3, backoff_base=1e-4,
+                          seed=0)
+    assert c.pull() == {"ok": 1}
+    assert c.retry_count == 2
+    assert calls["built"] == 3  # the connection is rebuilt per failure
+
+    c2 = ResilientPSClient(lambda: _AlwaysFail(), retries=2,
+                           backoff_base=1e-4)
+    with pytest.raises(PSRetryExhausted) as ei:
+        c2.pull()
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert c2.retry_count == 3  # initial + 2 retries
+
+    a = ResilientPSClient(lambda: None, retries=0, seed=5)
+    b = ResilientPSClient(lambda: None, retries=0, seed=5)
+    da = [a._backoff_delay(i) for i in range(1, 6)]
+    assert da == [b._backoff_delay(i) for i in range(1, 6)]
+    assert max(da) <= a.backoff_max
+
+    class Interrupted:
+        def pull(self):
+            raise KeyboardInterrupt
+
+        def close(self):
+            pass
+
+    with pytest.raises(KeyboardInterrupt):
+        ResilientPSClient(lambda: Interrupted(), retries=5,
+                          backoff_base=1e-4).pull()
+
+
+def test_resilient_client_lost_ack_commit_is_exactly_once():
+    """The lost-ack shape end to end at the client: a commit that was
+    APPLIED but whose reply died is internally retried with the same
+    seq and deduped server-side — applied exactly once."""
+    ps = HostParameterServer(AdagRule(), _params(0))
+    armed = {"on": True}
+
+    class LostAck:
+        def pull(self):
+            return ps.pull(0)
+
+        def commit(self, payload, local=None, seq=None):
+            out = ps.commit(0, payload, local, seq=seq)
+            if armed.pop("on", False):
+                raise ConnectionError("ack lost")  # AFTER the apply
+            return out
+
+        def close(self):
+            pass
+
+    c = ResilientPSClient(lambda: LostAck(), retries=2,
+                          backoff_base=1e-4)
+    c.pull()
+    delta = jax.tree_util.tree_map(np.ones_like, _params(0))
+    c.commit(delta)
+    assert ps.num_commits == 1  # retried, deduped, applied once
+    c.commit(delta)
+    assert ps.num_commits == 2  # the next seq applies normally
+
+
+def test_ps_snapshot_roundtrip_preserves_dedupe(tmp_path):
+    """Snapshot → restore keeps center, clocks, staleness AND the
+    commit-seq dedupe table: a lost-ack retry against the RESTORED
+    server still gets the cached reply instead of a second apply."""
+    ps = HostParameterServer(AdagRule(), _params(0))
+    ps.pull(0)
+    d1 = jax.tree_util.tree_map(np.ones_like, _params(0))
+    ps.commit(0, d1, seq=0)
+    reply = ps.commit(0, d1, seq=1)
+    path = ps.save_snapshot(tmp_path / "ps.snap")
+
+    ps2 = HostParameterServer.from_snapshot(AdagRule(), path)
+    assert ps2.num_commits == 2 and ps2._clock == ps._clock
+    assert ps2.staleness_log == ps.staleness_log
+    for k in ps.center:
+        np.testing.assert_array_equal(ps2.center[k], ps.center[k])
+    center_before = jax.tree_util.tree_map(np.copy, ps2.center)
+    again = ps2.commit(0, d1, seq=1)  # the retry a crash orphaned
+    assert ps2.num_commits == 2
+    for a, b in zip(jax.tree_util.tree_leaves(reply),
+                    jax.tree_util.tree_leaves(again)):
+        np.testing.assert_array_equal(a, b)
+    for k in ps2.center:
+        np.testing.assert_array_equal(ps2.center[k], center_before[k])
+    ps2.commit(0, d1, seq=2)
+    assert ps2.num_commits == 3
+
+
+def test_periodic_snapshots_written_under_commits(tmp_path):
+    path = tmp_path / "ps.snap"
+    ps = HostParameterServer(AdagRule(), _params(0),
+                             snapshot_path=path, snapshot_every=2)
+    ps.pull(0)
+    delta = jax.tree_util.tree_map(np.ones_like, _params(0))
+    for s in range(5):
+        ps.commit(0, delta, seq=s)
+    assert ps.num_snapshots == 2 and path.exists()
+    restored = HostParameterServer.from_snapshot(AdagRule(), path)
+    assert restored.num_commits == 4  # the last multiple of 2
+    with pytest.raises(ValueError, match="snapshot_path"):
+        HostParameterServer(AdagRule(), _params(0), snapshot_every=2)
+
+
+def test_fault_tolerance_kwargs_validation(tmp_path):
+    with pytest.raises(ValueError, match="transport='socket'"):
+        DOWNPOUR(MLP, fidelity="host", ps_address=("127.0.0.1", 1))
+    with pytest.raises(ValueError, match="fidelity='host'"):
+        DOWNPOUR(MLP, ps_snapshot_path=str(tmp_path / "s"),
+                 ps_snapshot_every=1)
+    with pytest.raises(ValueError, match="ps_snapshot_path"):
+        DOWNPOUR(MLP, fidelity="host", ps_snapshot_every=2)
+    with pytest.raises(ValueError, match="externally created"):
+        DOWNPOUR(MLP, fidelity="host", transport="socket",
+                 ps_address=("127.0.0.1", 1),
+                 ps_snapshot_path=str(tmp_path / "s"),
+                 ps_snapshot_every=1)
+
+
+def test_trainer_periodic_ps_snapshot_and_history_key(tmp_path):
+    """``ps_snapshot_every`` on the trainer writes warm-restart
+    snapshots through training and records ``history['ps_snapshots']``;
+    the file warm-restarts a server whose bookkeeping matches."""
+    path = tmp_path / "ps.snap"
+    t = DOWNPOUR(MLP, fidelity="host", num_workers=2,
+                 communication_window=2, batch_size=16, num_epoch=1,
+                 learning_rate=0.01, worker_optimizer="adam",
+                 ps_snapshot_path=str(path), ps_snapshot_every=4)
+    t.train(DATA)
+    ps = t.parameter_server_state
+    assert t.history["ps_snapshots"][-1] == ps.num_snapshots > 0
+    restored = HostParameterServer.from_snapshot(type(ps.rule)(), path)
+    assert restored.num_commits == (ps.num_commits // 4) * 4
+    if restored.num_commits == ps.num_commits:
+        for a, b in zip(jax.tree_util.tree_leaves(restored.center),
+                        jax.tree_util.tree_leaves(ps.center)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_ps_kill_restart_mid_training_byte_identical(tmp_path):
+    """THE acceptance scenario: an externally managed PS is killed
+    mid-training (snapshot_every=1) and warm-restarted on the same
+    port; the single worker's resilient client rides its backoff
+    through the outage, the commit-seq dedupe table proves at-most-once
+    across the crash, and the final center is byte-identical to an
+    uninterrupted run at the same commit schedule."""
+    from distkeras_tpu.models import ModelSpec
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    model = ModelSpec.from_config(MLP).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+    kwargs = dict(fidelity="host", transport="socket", num_workers=1,
+                  communication_window=2, batch_size=16, num_epoch=1,
+                  learning_rate=0.01, worker_optimizer="adam",
+                  worker_retries=12)
+
+    # uninterrupted baseline against an external server
+    ps_a = HostParameterServer(DownpourRule(), center)
+    with PSServer(ps_a, center) as srv_a:
+        base = DOWNPOUR(MLP, ps_address=srv_a.address, **kwargs)
+        base.train(DATA, initial_variables=variables)
+    n_rounds = len(base.history["round_loss"])
+    assert ps_a.num_commits == n_rounds
+
+    # the kill/restart run: same schedule, crash after the 5th commit
+    snap = tmp_path / "ps.snap"
+    ps_b = HostParameterServer(DownpourRule(), center,
+                               snapshot_path=snap, snapshot_every=1)
+    srv_b = PSServer(ps_b, center).start()
+    port = srv_b.address[1]
+    box = {}
+
+    def killer():
+        while srv_b.ps.num_commits < 5:
+            time.sleep(0.002)
+        srv_b.kill()  # listening socket AND live conns die mid-run
+        # warm restart on the SAME port so the reconnecting client
+        # finds it (bind may need a beat for the dead socket to clear)
+        for _ in range(50):
+            try:
+                box["srv2"] = PSServer.restart_from(
+                    snap, DownpourRule(), center, port=port)
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise OSError(f"could not rebind port {port}")
+
+    k = threading.Thread(target=killer)
+    k.start()
+    t = DOWNPOUR(MLP, ps_address=("127.0.0.1", port), **kwargs)
+    t.train(DATA, initial_variables=variables)
+    k.join()
+    srv2 = box["srv2"]
+    try:
+        # the outage really happened and the client retried through it
+        assert srv2.ps.num_commits > 5
+        assert t.history.get("worker_round_retries"), (
+            "the kill was invisible to the worker — test proved "
+            "nothing")
+        # at-most-once across the crash: total applied commits ==
+        # rounds (the dedupe table absorbed any lost-ack retry)
+        assert srv2.ps.num_commits == n_rounds
+        # byte-identical center vs. the uninterrupted run
+        for a, b in zip(jax.tree_util.tree_leaves(srv2.ps.center),
+                        jax.tree_util.tree_leaves(ps_a.center)):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(base.trained_variables),
+                jax.tree_util.tree_leaves(t.trained_variables)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+    finally:
+        srv2.stop()

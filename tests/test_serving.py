@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from distkeras_tpu.models import ModelSpec, generate, model_config
-from distkeras_tpu.serving import DecodeEngine
+from distkeras_tpu.serving import DecodeEngine, ShedError
 
 jax.config.update("jax_platforms", "cpu")
 
@@ -280,6 +280,180 @@ def test_slot_pos_contract_validation():
     with pytest.raises(ValueError, match="cache_envelope"):
         model.clone(decode=True, cache_envelope=MAXLEN + 1).apply(
             params, jnp.zeros((1, 4), jnp.int32), mutable=["cache"])
+
+
+def test_duplicate_inflight_request_id_rejected():
+    """Mixed explicit/auto ids cannot silently collide and
+    cross-deliver: a duplicate in-flight id is rejected at submit, and
+    auto-assignment skips over in-flight explicit ids.  Finished ids
+    become reusable."""
+    model, variables = _model()
+    (p,) = _prompts([5])
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=2)
+    eng.submit(p, request_id=7)
+    with pytest.raises(ValueError, match="in flight"):
+        eng.submit(p, request_id=7)
+    # the auto path must never hand out an id an explicit caller holds
+    eng2 = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                        max_new_tokens=2)
+    eng2.submit(p, request_id=0)          # occupies the first auto id
+    auto = eng2.submit(p)
+    assert auto != 0
+    ids = {r["request_id"] for r in eng2.drain()}
+    assert ids == {0, auto}
+    # after finishing, the id is free again
+    assert eng2.submit(p, request_id=0) == 0
+    eng2.drain()
+
+
+def test_queue_bound_overload_sheds_and_survivors_complete():
+    """2x queue-bound overload: submits beyond slots + queue_bound shed
+    with ShedError + serving_shed_total > 0; every ACCEPTED request
+    still completes with correct greedy tokens (admission control
+    degrades capacity, never correctness)."""
+    from distkeras_tpu import telemetry
+
+    tel = telemetry.enable()
+    try:
+        model, variables = _model()
+        slots, bound = 2, 2
+        eng = DecodeEngine(model, variables, slots=slots,
+                           prefill_align=4, max_new_tokens=4,
+                           queue_bound=bound)
+        prompts = _prompts([5] * (2 * (slots + bound)), seed=31)
+        accepted, shed = [], 0
+        for i, p in enumerate(prompts):
+            # keep slots saturated: admit only when a step would; the
+            # queue alone absorbs up to `bound`, the rest shed
+            try:
+                accepted.append(eng.submit(p, request_id=i))
+            except ShedError as e:
+                assert e.reason == "queue_full"
+                shed += 1
+        assert shed > 0
+        assert tel.metrics.sum_counter("serving_shed_total") == shed
+        res = {r["request_id"]: r for r in eng.drain()}
+        assert sorted(res) == sorted(accepted)
+        for rid, r in res.items():
+            assert "error" not in r
+            np.testing.assert_array_equal(
+                r["tokens"], _want(model, variables, prompts[rid], 4))
+    finally:
+        telemetry.disable()
+
+
+def test_poisoned_request_isolated_as_error_result():
+    """A request whose prefill raises is finished with an ``error``
+    result; its neighbors' slots keep decoding to correct tokens and
+    the engine keeps serving afterwards."""
+    model, variables = _model()
+    prompts = _prompts([5, 6, 7], seed=37)
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=4)
+    pool = eng._pools[0]
+    real_prefill = pool.prefill_fn
+
+    def poisoned(variables, cache, state, prompt, slot, last_idx,
+                 n_left0, eos_id, rng):
+        if int(last_idx) == len(prompts[1]) - 1:  # request 1 only
+            raise RuntimeError("poisoned prompt")
+        return real_prefill(variables, cache, state, prompt, slot,
+                            last_idx, n_left0, eos_id, rng)
+
+    pool.prefill_fn = poisoned
+    res = {r["request_id"]: r for r in eng.run(
+        [{"prompt": p} for p in prompts])}
+    assert "poisoned prompt" in res[1]["error"]
+    assert len(res[1]["tokens"]) == 0 and res[1]["ttft"] is None
+    for i in (0, 2):
+        assert "error" not in res[i]
+        np.testing.assert_array_equal(
+            res[i]["tokens"], _want(model, variables, prompts[i], 4))
+    # the engine is not stalled: it serves the next workload fine
+    pool.prefill_fn = real_prefill
+    (ok,) = list(eng.run([prompts[0]]))
+    np.testing.assert_array_equal(
+        ok["tokens"], _want(model, variables, prompts[0], 4))
+
+
+def test_deadline_expires_queued_and_live_requests():
+    """An already-expired queued request is shed at admission with an
+    error result; a live request past its deadline frees its slot; a
+    deadline-free neighbor finishes untouched."""
+    model, variables = _model()
+    prompts = _prompts([5, 5], seed=41)
+    eng = DecodeEngine(model, variables, slots=1, prefill_align=4,
+                       max_new_tokens=6)
+    eng.submit(prompts[0], request_id=0)              # takes the slot
+    eng.submit(prompts[1], request_id=1, deadline=1e-9)  # expires queued
+    res = {r["request_id"]: r for r in eng.drain()}
+    assert res[1]["error"] == "deadline_exceeded"
+    assert "error" not in res[0]
+    np.testing.assert_array_equal(
+        res[0]["tokens"], _want(model, variables, prompts[0], 6))
+    # live expiry: a decoding request past its deadline frees the slot
+    # (backdate the deadline once admitted, the idle-worker idiom)
+    from distkeras_tpu import telemetry
+
+    eng.submit(prompts[0], request_id=2, deadline=3600.0)
+    eng.step()                            # admitted into the slot
+    (req,) = [q for q in eng._pools[0].reqs if q is not None]
+    req.deadline = telemetry.now() - 1.0  # expired mid-decode
+    (r,) = eng.drain()
+    assert r["error"] == "deadline_exceeded"
+    assert len(r["tokens"]) >= 1          # prefill had already landed
+    with pytest.raises(ValueError, match="deadline"):
+        eng.submit(prompts[0], deadline=0.0)
+
+
+def test_drain_returns_every_inflight_and_close_cancels():
+    """drain() returns exactly the in-flight set; close() cancels the
+    remainder (error="engine_closed", nothing vanishes) and further
+    submit/step raise."""
+    model, variables = _model()
+    prompts = _prompts([5, 6, 4, 7, 5], seed=43)
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=4)
+    rids = [eng.submit(p) for p in prompts]
+    drained = {r["request_id"] for r in eng.drain()}
+    assert drained == set(rids)
+    assert not eng.has_work()
+    # now cancel mid-flight: 2 in slots (after one step) + 2 queued
+    rids = [eng.submit(p, request_id=100 + i)
+            for i, p in enumerate(prompts[:4])]
+    eng.step()
+    cancelled = eng.close()
+    assert {r["request_id"] for r in cancelled} == set(rids)
+    assert all(r["error"] == "engine_closed" for r in cancelled)
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(prompts[0])
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.step()
+    assert eng.close() == []              # idempotent
+
+
+def test_streaming_continuous_backpressure_with_queue_bound():
+    """StreamingGenerator(engine='continuous') over a queue_bound
+    engine converts sheds into backpressure: every row still comes
+    back, in order, with correct greedy tokens."""
+    from distkeras_tpu.streaming import StreamingGenerator
+
+    model, variables = _model()
+    prompts = _prompts([5, 7, 5, 6, 5, 4, 6, 5], seed=47)
+    gen = StreamingGenerator(
+        model, variables, max_new_tokens=4, batch_size=2,
+        engine="continuous",
+        engine_options={"slots": 2, "prefill_align": 4,
+                        "queue_bound": 1})
+    out = list(gen.generate_stream(
+        [{"prompt": p, "i": i} for i, p in enumerate(prompts)]))
+    assert [r["i"] for r in out] == list(range(len(prompts)))
+    for r in out:
+        assert "generated_error" not in r
+        np.testing.assert_array_equal(
+            r["generated"][:4],
+            _want(model, variables, prompts[r["i"]], 4))
 
 
 def test_cache_envelope_bounds_chunk_and_positions():
