@@ -99,6 +99,30 @@ def load_ps_snapshot(path: str | os.PathLike) -> Pytree:
         pathlib.Path(path).read_bytes())
 
 
+def ps_snapshot_info(path: str | os.PathLike) -> dict:
+    """Operational peek at a PS snapshot file: which server class
+    wrote it and how far it got.  Returns ``{"sharded": K or None,
+    "num_commits": int, "workers_cached": int}`` — ``sharded`` drives
+    ``PSServer.restart_from``'s dispatch (an unsharded
+    ``HostParameterServer`` snapshot has no ``"sharded"`` key; a
+    ``ShardedParameterServer`` snapshot carries the shard count plus
+    per-shard clock/dedupe sections)."""
+    snap = load_ps_snapshot(path)
+    if "sharded" in snap:
+        shards = snap["shards"]
+        return {
+            "sharded": int(snap["sharded"]),
+            "num_commits": int(shards[0]["num_commits"]),
+            "workers_cached": len({w for s in shards
+                                   for w in s["last_reply"]}),
+        }
+    return {
+        "sharded": None,
+        "num_commits": int(snap["num_commits"]),
+        "workers_cached": len(snap["last_reply"]),
+    }
+
+
 SHARDED = "ckpt_sharded"
 _POINTER = "LATEST"
 

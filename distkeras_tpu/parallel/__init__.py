@@ -2,6 +2,12 @@ from distkeras_tpu.parallel.host_ps import (  # noqa: F401
     HostParameterServer,
     PSClient,
     PSServer,
+    ResilientPSClient,
+)
+from distkeras_tpu.parallel.sharded_ps import (  # noqa: F401
+    ShardedParameterServer,
+    ShardedPSClient,
+    plan_shards,
 )
 from distkeras_tpu.parallel.moe import (  # noqa: F401
     MoEAux,

@@ -45,28 +45,56 @@ class DeltaCodec:
     def decode_leaf(self, enc: dict, shape, dtype) -> np.ndarray:
         raise NotImplementedError
 
-    def encode(self, tree: Pytree) -> bytes:
-        leaves = jax.tree_util.tree_leaves(tree)
+    def encode_leaves(self, leaves) -> bytes:
+        """Encode an ordered leaf LIST — the per-shard unit the
+        sharded PS wire commits (``parallel.sharded_ps``); the
+        full-tree ``encode`` is the K=1 special case."""
         return msgpack.packb(
             [self.encode_leaf(np.asarray(x, np.float32))
              for x in leaves])
 
-    def decode(self, data: bytes, template: Pytree) -> Pytree:
-        leaves, treedef = jax.tree_util.tree_flatten(template)
+    def decode_leaves(self, data, template_leaves) -> list:
+        """Inverse of ``encode_leaves`` against the shard's template
+        leaves (shapes/dtypes)."""
         enc = msgpack.unpackb(data)
-        if len(enc) != len(leaves):
+        if len(enc) != len(template_leaves):
             raise ValueError(
                 f"encoded payload has {len(enc)} leaves, template has "
-                f"{len(leaves)}")
-        out = [self.decode_leaf(e, np.shape(t), np.asarray(t).dtype)
-               for e, t in zip(enc, leaves)]
-        return jax.tree_util.tree_unflatten(treedef, out)
+                f"{len(template_leaves)}")
+        return [self.decode_leaf(e, np.shape(t), np.asarray(t).dtype)
+                for e, t in zip(enc, template_leaves)]
+
+    def encode(self, tree: Pytree) -> bytes:
+        return self.encode_leaves(jax.tree_util.tree_leaves(tree))
+
+    def decode(self, data: bytes, template: Pytree) -> Pytree:
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        return jax.tree_util.tree_unflatten(
+            treedef, self.decode_leaves(data, leaves))
 
     def round_trip(self, tree: Pytree) -> tuple[bytes, Pytree]:
         """``(wire bytes, the tree the receiver will reconstruct)`` —
         the reconstruction is what error feedback subtracts."""
         data = self.encode(tree)
         return data, self.decode(data, tree)
+
+    def round_trip_shards(self, tree: Pytree, plan
+                          ) -> tuple[list[bytes], Pytree]:
+        """Per-shard ``round_trip``: encode each shard's leaf slice
+        separately (``plan`` is ``sharded_ps.plan_shards`` output) so
+        the worker loop encodes ONCE and hands the ready shard bodies
+        to ``ShardedPSClient.commit``; the decoded reassembly is what
+        error feedback subtracts — identical math to the full-tree
+        ``round_trip`` (the codec is per-leaf)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        datas = [self.encode_leaves([leaves[i] for i in idx])
+                 for idx in plan]
+        out = [None] * len(leaves)
+        for idx, data in zip(plan, datas):
+            for i, leaf in zip(idx, self.decode_leaves(
+                    data, [leaves[i] for i in idx])):
+                out[i] = leaf
+        return datas, jax.tree_util.tree_unflatten(treedef, out)
 
 
 class Int8Codec(DeltaCodec):

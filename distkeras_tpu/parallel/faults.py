@@ -194,23 +194,61 @@ class ChaosTransport:
             raise ConnectionResetError("chaos: recv reset")
         return self._orig[2](sock)
 
+    def _send_msg_gather(self, sock, *parts):
+        """The zero-copy scatter-gather send (the sharded-PS wire)
+        crosses the same choke point: same fault classes, same
+        schedule stream.  Truncation materializes the frame (a copy is
+        fine on the chaos path) to cut a strict prefix."""
+        fault = self._draw("send")
+        if fault == "delay":
+            telemetry.instant("chaos_delay", op="send")
+            _sleep(self.delay_s)
+        if fault == "reset":
+            _hard_close(sock)
+            raise ConnectionResetError("chaos: send reset")
+        if fault == "truncate":
+            data = transport.frame(*parts)
+            cut = 1 + int(self._cut_fraction() * (len(data) - 1))
+            cut = min(cut, len(data) - 1)
+            try:
+                sock.sendall(data[:cut])
+            finally:
+                _hard_close(sock)
+            raise ConnectionError(
+                f"chaos: frame truncated at {cut}/{len(data)} bytes")
+        return self._orig[3](sock, *parts)
+
+    def _recv_msg_into(self, sock):
+        fault = self._draw("recv")
+        if fault == "delay":
+            telemetry.instant("chaos_delay", op="recv")
+            _sleep(self.delay_s)
+        if fault == "reset":
+            _hard_close(sock)
+            raise ConnectionResetError("chaos: recv reset")
+        return self._orig[4](sock)
+
     # -- install / uninstall ----------------------------------------------
 
     def install(self) -> "ChaosTransport":
         if self._installed:
             raise RuntimeError("ChaosTransport already installed")
         self._orig = (transport.connect, transport.send_msg,
-                      transport.recv_msg)
+                      transport.recv_msg, transport.send_msg_gather,
+                      transport.recv_msg_into)
         self._installed = True
         transport.connect = self._connect
         transport.send_msg = self._send_msg
         transport.recv_msg = self._recv_msg
+        transport.send_msg_gather = self._send_msg_gather
+        transport.recv_msg_into = self._recv_msg_into
         return self
 
     def uninstall(self) -> None:
         if not self._installed:
             return
-        transport.connect, transport.send_msg, transport.recv_msg = (
+        (transport.connect, transport.send_msg, transport.recv_msg,
+         transport.send_msg_gather, transport.recv_msg_into) = (
             self._orig)
         self._installed = False
         # self._orig is deliberately KEPT: a daemon PS handler thread

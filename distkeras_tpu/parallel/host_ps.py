@@ -47,6 +47,21 @@ def _to_numpy(tree: Pytree) -> Pytree:
     return jax.tree_util.tree_map(np.asarray, tree)
 
 
+def _readonly_view(x: np.ndarray) -> np.ndarray:
+    """A no-copy read-only view: what ``pull``/``commit`` hand out so
+    the in-process arm cannot alias-and-mutate server state (arrays
+    built on read-only buffers — ``frombuffer`` views — already are)."""
+    if not x.flags.writeable:
+        return x
+    v = x.view()
+    v.flags.writeable = False
+    return v
+
+
+def _readonly_tree(tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(_readonly_view, tree)
+
+
 def pack_params(tree, template=None) -> bytes:
     """Raw-buffer wire encoding: leaves in canonical pytree order,
     concatenated ``tobytes()``.  Shapes/dtypes ride the TEMPLATE both
@@ -104,7 +119,18 @@ class HostParameterServer:
     commit clock; a commit's staleness is the number of commits applied
     since the committing worker's last pull (SURVEY.md §2.1
     DynSGDParameterServer).
+
+    ``staleness_log`` keeps only the last ``STALENESS_LOG_WINDOW``
+    entries (a long run would otherwise grow one int per commit
+    forever); the unbounded-horizon record is the
+    ``ps_commit_staleness`` telemetry histogram, which aggregates
+    without growing.
     """
+
+    #: entries retained in ``staleness_log`` (the newest ones); the
+    #: telemetry histogram is the full-horizon record.  Trimming is
+    #: amortized: the list briefly overshoots by 25% before a cut.
+    STALENESS_LOG_WINDOW = 100_000
 
     def __init__(self, rule: UpdateRule, center: Pytree, *,
                  snapshot_path: str | os.PathLike | None = None,
@@ -133,16 +159,25 @@ class HostParameterServer:
             raise ValueError(
                 "snapshot_every needs a snapshot_path to write to")
         self._last_seen: dict[int, float] = {}
-        self._last_reply: dict[int, tuple[int, Pytree]] = {}
+        # worker -> (seq, packed reply bytes).  Packed — not a live
+        # tree — so the cache's footprint is explicit and measurable
+        # (``ps_reply_cache_bytes`` gauge) instead of a hidden full
+        # param copy per worker pinned by aliasing.
+        self._last_reply: dict[int, tuple[int, bytes]] = {}
+        self._reply_bytes = 0
 
     # -- the two verbs -----------------------------------------------------
 
     def pull(self, worker_id: int) -> Pytree:
+        """Returns READ-ONLY views of the center (no copy): the
+        in-process arm must not be able to mutate server state through
+        the pulled tree (every consumer treats pulls as immutable; the
+        views enforce it)."""
         telemetry.metrics().counter("ps_pulls_total").inc()
         with self._lock:
             self._pull_clock[worker_id] = self._clock
             self._last_seen[worker_id] = telemetry.now()
-            return self._center
+            return _readonly_tree(self._center)
 
     def commit(self, worker_id: int, payload: Pytree,
                local: Pytree | None = None,
@@ -178,7 +213,7 @@ class HostParameterServer:
                 if last is not None and seq <= last[0]:
                     self._last_seen[worker_id] = telemetry.now()
                     m.counter("ps_commit_dedup_total").inc()
-                    return last[1]
+                    return unpack_params(self._center, last[1])
             staleness = self._clock - self._pull_clock.get(worker_id, 0)
             state = PSState(center=self._center,
                             clock=np.int32(self._clock))
@@ -190,6 +225,8 @@ class HostParameterServer:
             self._clock += 1
             self._pull_clock[worker_id] = self._clock
             self.staleness_log.append(int(staleness))
+            if len(self.staleness_log) > self.STALENESS_LOG_WINDOW * 5 // 4:
+                del self.staleness_log[:-self.STALENESS_LOG_WINDOW]
             self.num_commits += 1
             self._last_seen[worker_id] = telemetry.now()
             m.counter("ps_commits_total").inc()
@@ -198,18 +235,47 @@ class HostParameterServer:
                         ).observe(int(staleness))
             pulled = _to_numpy(pulled)
             if seq is not None:
-                self._last_reply[worker_id] = (seq, pulled)
+                self._cache_reply_locked(worker_id, seq,
+                                         pack_params(pulled))
             if (self._snapshot_every
                     and self.num_commits % self._snapshot_every == 0):
                 # inside the lock, BEFORE the reply escapes: an acked
                 # commit is durable (see __init__)
                 self._write_snapshot_locked()
-            return pulled
+            return _readonly_tree(pulled)
+
+    def _cache_reply_locked(self, worker_id: int, seq: int,
+                            packed: bytes) -> None:
+        old = self._last_reply.get(worker_id)
+        if old is not None:
+            self._reply_bytes -= len(old[1])
+        self._last_reply[worker_id] = (seq, packed)
+        self._reply_bytes += len(packed)
+        telemetry.metrics().gauge("ps_reply_cache_bytes").set(
+            self._reply_bytes)
+
+    def commit_packed(self, worker_id: int, payload: Pytree,
+                      local: Pytree | None = None,
+                      seq: int | None = None) -> bytes:
+        """``commit`` returning the WIRE encoding of the reply
+        (``pack_params`` bytes): the socket handler's path, which packs
+        exactly once — the same bytes land in the dedupe cache and on
+        the socket (a dedupe hit returns the cached bytes with no
+        repack at all)."""
+        pulled = self.commit(worker_id, payload, local, seq=seq)
+        if seq is not None:
+            # commit() just cached this reply's pack — reuse it (one
+            # pack per commit, shared between cache and wire)
+            with self._lock:
+                last = self._last_reply.get(worker_id)
+                if last is not None and last[0] == seq:
+                    return last[1]
+        return pack_params(pulled)
 
     @property
     def center(self) -> Pytree:
         with self._lock:
-            return self._center
+            return _readonly_tree(self._center)
 
     def register(self, worker_id: int) -> None:
         """Start liveness monitoring before first contact, so a worker
@@ -223,13 +289,19 @@ class HostParameterServer:
         ``idle_workers`` never flags it) and drop its dedupe reply."""
         with self._lock:
             self._last_seen.pop(worker_id, None)
-            self._last_reply.pop(worker_id, None)
+            dropped = self._last_reply.pop(worker_id, None)
+            if dropped is not None:
+                self._reply_bytes -= len(dropped[1])
+                telemetry.metrics().gauge("ps_reply_cache_bytes").set(
+                    self._reply_bytes)
 
     def clear_reply_cache(self) -> None:
-        """Drop all cached dedupe replies (a full param copy per
-        worker) — for when no client can retry anymore."""
+        """Drop all cached dedupe replies (a full packed param copy
+        per worker) — for when no client can retry anymore."""
         with self._lock:
             self._last_reply.clear()
+            self._reply_bytes = 0
+            telemetry.metrics().gauge("ps_reply_cache_bytes").set(0)
 
     def idle_workers(self, timeout: float) -> list[int]:
         """Failure *detection* (SURVEY.md §5 row the reference left
@@ -261,8 +333,8 @@ class HostParameterServer:
                            for w, c in self._pull_clock.items()},
             "staleness_log": np.asarray(self.staleness_log, np.int64),
             "last_reply": {str(w): {"seq": np.uint64(seq),
-                                    "pulled": pulled}
-                           for w, (seq, pulled)
+                                    "packed": packed}
+                           for w, (seq, packed)
                            in self._last_reply.items()},
         }
 
@@ -305,6 +377,11 @@ class HostParameterServer:
             from distkeras_tpu import checkpoint as ckpt
 
             snapshot = ckpt.load_ps_snapshot(snapshot)
+        if "sharded" in snapshot:
+            raise ValueError(
+                "this snapshot came from a ShardedParameterServer "
+                f"(K={int(snapshot['sharded'])}); restore it with "
+                "sharded_ps.ShardedParameterServer.from_snapshot")
         ps = cls(rule, snapshot["center"], snapshot_path=snapshot_path,
                  snapshot_every=snapshot_every)
         ps._clock = int(snapshot["clock"])
@@ -313,8 +390,10 @@ class HostParameterServer:
                           in snapshot["pull_clock"].items()}
         ps.staleness_log = [int(s) for s
                             in np.asarray(snapshot["staleness_log"])]
-        ps._last_reply = {int(w): (int(e["seq"]), e["pulled"])
-                          for w, e in snapshot["last_reply"].items()}
+        for w, e in snapshot["last_reply"].items():
+            packed = (bytes(e["packed"]) if "packed" in e
+                      else pack_params(e["pulled"]))  # pre-round-8 file
+            ps._cache_reply_locked(int(w), int(e["seq"]), packed)
         return ps
 
 
@@ -331,14 +410,30 @@ class PSServer:
     shuts the server down.
     """
 
-    def __init__(self, ps: HostParameterServer, template: Pytree,
+    def __init__(self, ps, template: Pytree,
                  host: str = "127.0.0.1", port: int = 0):
-        """The handshake frame is ``4-byte worker id`` optionally
+        """``ps`` is a ``HostParameterServer`` or a
+        ``sharded_ps.ShardedParameterServer`` — the latter additionally
+        serves the shard-addressed ops ``b"P"`` (version-delta pull)
+        and ``b"C"`` (per-shard commit) over the zero-copy
+        scatter-gather wire (``transport.send_msg_gather`` /
+        ``recv_msg_into``); the classic ``b"p"``/``b"c"`` verbs keep
+        working against either server.
+
+        The handshake frame is ``4-byte worker id`` optionally
         followed by a codec name (``parallel.compression``): commits on
         that connection then arrive codec-encoded instead of via the
         raw template-implied ``pack_params`` encoding — the wire-compression arm."""
         self.ps = ps
         self._template = _to_numpy(template)
+        # duck-typed (no import cycle): the sharded server exposes the
+        # per-shard verbs and its plan
+        self._sharded = getattr(ps, "num_shards", 1) > 1 or \
+            hasattr(ps, "pull_since")
+        if self._sharded:
+            tleaves = jax.tree_util.tree_leaves(self._template)
+            self._shard_templates = [[tleaves[i] for i in idx]
+                                     for idx in ps.plan]
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -399,9 +494,9 @@ class PSServer:
 
                     codec = resolve_codec(hello[4:].decode())
                 while True:
-                    msg = transport.recv_msg(conn)
+                    msg = transport.recv_msg_into(conn)
                     rx.inc(len(msg))
-                    cmd, body = msg[:1], msg[1:]
+                    cmd, body = bytes(msg[:1]), msg[1:]
                     if cmd == b"p":
                         wire = pack_params(
                             self.ps.pull(worker_id), self._template)
@@ -422,11 +517,55 @@ class PSServer:
                             raw = transport.recv_msg(conn)
                             rx.inc(len(raw))
                             local = unpack_params(self._template, raw)
-                        pulled = self.ps.commit(worker_id, payload,
-                                                local, seq=seq)
-                        wire = pack_params(pulled, self._template)
+                        if hasattr(self.ps, "commit_packed"):
+                            # single pack, shared with the dedupe cache
+                            wire = self.ps.commit_packed(
+                                worker_id, payload, local, seq=seq)
+                        else:
+                            wire = pack_params(
+                                self.ps.commit(worker_id, payload,
+                                               local, seq=seq),
+                                self._template)
                         tx.inc(len(wire))
                         transport.send_msg(conn, wire)
+                    elif cmd == b"P" and self._sharded:
+                        from distkeras_tpu.parallel.sharded_ps import (
+                            leaf_buffers)
+
+                        k = self.ps.num_shards
+                        since = [int.from_bytes(body[8 * i:8 * i + 8],
+                                                "big")
+                                 for i in range(k)]
+                        included, _, _ = self.ps.pull_since(worker_id,
+                                                            since)
+                        head = len(included).to_bytes(2, "big") + \
+                            b"".join(s.to_bytes(2, "big")
+                                     + c.to_bytes(8, "big")
+                                     for s, c, _ in included)
+                        parts = [head]
+                        for s, _, leaves in included:
+                            parts.extend(leaf_buffers(
+                                leaves, self._shard_templates[s]))
+                        tx.inc(transport.send_msg_gather(conn, *parts))
+                    elif cmd == b"C" and self._sharded:
+                        from distkeras_tpu.parallel.sharded_ps import (
+                            leaf_buffers, unpack_leaves)
+
+                        k = int.from_bytes(body[:2], "big")
+                        seq = int.from_bytes(body[2:10], "big")
+                        if seq == _NO_SEQ:
+                            seq = None
+                        temps = self._shard_templates[k]
+                        if codec is not None:
+                            leaves = codec.decode_leaves(body[10:],
+                                                         temps)
+                        else:
+                            leaves = unpack_leaves(temps, body[10:])
+                        clock, pulled = self.ps.commit_shard(
+                            worker_id, k, leaves, seq=seq)
+                        tx.inc(transport.send_msg_gather(
+                            conn, clock.to_bytes(8, "big"),
+                            *leaf_buffers(pulled, temps)))
                     elif cmd == b"d":
                         # clean worker finish: retire from liveness
                         # monitoring and drop its dedupe reply
@@ -482,10 +621,25 @@ class PSServer:
         snapshot dict or file.  Commit-seq dedupe survives the restart,
         so a client retrying a commit the dead server already applied
         (and snapshotted) gets its cached reply instead of
-        double-applying the delta.  Returns a STARTED server."""
-        ps = HostParameterServer.from_snapshot(
-            rule, snapshot, snapshot_path=snapshot_path,
-            snapshot_every=snapshot_every)
+        double-applying the delta.  Dispatches on the snapshot's
+        ``"sharded"`` key, so a ``ShardedParameterServer`` snapshot
+        restarts sharded (same K, plan re-derived from the saved
+        center).  Returns a STARTED server."""
+        if isinstance(snapshot, (str, os.PathLike)):
+            from distkeras_tpu import checkpoint as ckpt
+
+            snapshot = ckpt.load_ps_snapshot(snapshot)
+        if "sharded" in snapshot:
+            from distkeras_tpu.parallel.sharded_ps import (
+                ShardedParameterServer)
+
+            ps = ShardedParameterServer.from_snapshot(
+                rule, snapshot, snapshot_path=snapshot_path,
+                snapshot_every=snapshot_every)
+        else:
+            ps = HostParameterServer.from_snapshot(
+                rule, snapshot, snapshot_path=snapshot_path,
+                snapshot_every=snapshot_every)
         telemetry.metrics().counter("ps_restarts_total").inc()
         telemetry.instant("ps_restart", commits=ps.num_commits)
         return cls(ps, template, host=host, port=port).start()
@@ -657,9 +811,24 @@ class ResilientPSClient:
 
     @classmethod
     def for_address(cls, host: str, port: int, *, worker_id: int,
-                    template: Pytree, codec=None, **kwargs
+                    template: Pytree, codec=None, shards: int = 1,
+                    shard_stats: dict | None = None, **kwargs
                     ) -> "ResilientPSClient":
-        """Socket arm: (re)connects a ``PSClient`` to a ``PSServer``."""
+        """Socket arm: (re)connects a ``PSClient`` — or, with
+        ``shards > 1``, a ``sharded_ps.ShardedPSClient`` speaking the
+        shard-addressed zero-copy wire (``shard_stats`` accumulates its
+        version-delta pull savings across reconnects) — to a
+        ``PSServer``.  Retries are shard-aware for free: the one seq
+        stamped per logical commit rides every shard, so a retry after
+        a partial application re-applies exactly the missed shards."""
+        if shards > 1:
+            from distkeras_tpu.parallel.sharded_ps import (
+                ShardedPSClient)
+
+            return cls(lambda: ShardedPSClient(
+                host, port, worker_id=worker_id, template=template,
+                num_shards=shards, codec=codec, stats=shard_stats),
+                **kwargs)
         return cls(lambda: PSClient(host, port, worker_id=worker_id,
                                     template=template, codec=codec),
                    **kwargs)

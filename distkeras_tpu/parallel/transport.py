@@ -70,6 +70,67 @@ def send_msg(sock: socket.socket, *parts: bytes) -> None:
     sock.sendall(frame(*parts))
 
 
+# sendmsg gathers at most IOV_MAX buffers per call; 64 is far below
+# every platform's limit and keeps the partial-send bookkeeping short
+_IOV_MAX = 64
+
+
+def _as_byte_view(part) -> memoryview:
+    mv = part if isinstance(part, memoryview) else memoryview(part)
+    if mv.format != "B" or not mv.contiguous:
+        mv = mv.cast("B")
+    return mv
+
+
+def send_msg_gather(sock: socket.socket, *parts) -> int:
+    """Zero-copy scatter-gather variant of ``send_msg``: ``parts`` may
+    be ``bytes`` or ``memoryview``s (e.g. views of already-contiguous
+    parameter leaves) and are framed as ONE message but written via
+    ``socket.sendmsg`` — no ``tobytes()`` materialization and no
+    ``b"".join`` concatenation copy (the two host copies ``pack_params``
+    pays on the single-mutex PS wire, PERF.md §12/§25).  Returns the
+    body byte count (header excluded) for wire accounting."""
+    bufs = [_as_byte_view(p) for p in parts]
+    total = sum(b.nbytes for b in bufs)
+    bufs.insert(0, memoryview(_HEADER.pack(total)))
+    i = 0
+    while i < len(bufs):
+        sent = sock.sendmsg(bufs[i:i + _IOV_MAX])
+        while i < len(bufs) and sent >= bufs[i].nbytes:
+            sent -= bufs[i].nbytes
+            i += 1
+        if sent:  # partial write inside buffer i: resume mid-buffer
+            bufs[i] = bufs[i][sent:]
+    return total
+
+
+def recv_msg_into(sock: socket.socket) -> memoryview:
+    """Receive one framed message into a single preallocated buffer
+    (``recv_into`` — no chunk-list ``b"".join`` copy) and return a
+    read-only memoryview over it.  ``numpy.frombuffer`` accepts the
+    view directly, so a parameter payload is sliced into leaf arrays
+    with zero further copies."""
+    head = bytearray(_HEADER.size)
+    _recv_into_all(sock, memoryview(head))
+    (length,) = _HEADER.unpack(head)
+    if length > MAX_MSG_BYTES:
+        raise ValueError(
+            f"message length {length} exceeds sanity bound "
+            f"{MAX_MSG_BYTES} (DKT_MAX_MSG_BYTES)")
+    body = bytearray(length)
+    _recv_into_all(sock, memoryview(body))
+    return memoryview(body).toreadonly()
+
+
+def _recv_into_all(sock: socket.socket, mv: memoryview) -> None:
+    off, n = 0, mv.nbytes
+    while off < n:
+        got = sock.recv_into(mv[off:], min(n - off, 1 << 20))
+        if not got:
+            raise ConnectionError("peer closed mid-message")
+        off += got
+
+
 def _recvall(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n:

@@ -790,6 +790,7 @@ class DistributedTrainer(Trainer):
                  lr_law: str = "warn",
                  commit_overlap: bool = False,
                  ps_address: tuple[str, int] | None = None,
+                 ps_shards: int = 1,
                  ps_snapshot_path: str | None = None,
                  ps_snapshot_every: int = 0, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
@@ -844,7 +845,23 @@ class DistributedTrainer(Trainer):
         SURVEY.md §5), and an operator can kill/warm-restart it
         mid-run; requires ``transport='socket'`` (the server's rule
         must match this trainer's; staleness history stays
-        server-side)."""
+        server-side).
+
+        ``ps_shards=K`` (host arm, delta family) runs the PS sharded
+        (``parallel.sharded_ps``): the parameter tree's leaves are
+        partitioned into K byte-balanced shards, each with its own
+        lock/clock/dedupe, so commits from different workers proceed
+        per shard instead of convoying on one mutex; over
+        ``transport='socket'`` the exchange additionally rides the
+        zero-copy scatter-gather wire with version-delta pulls
+        (``history['pull_shards_skipped'/'pull_bytes_saved']``).
+        With an external ``ps_address`` the server must have been
+        created with the same K.  ``commit_overlap=True`` on the host
+        arm double-buffers each worker's loop: the commit/pull
+        exchange for window *n* runs on a background thread while the
+        device computes window *n+1* (the worker trains one exchange
+        behind — +1 round of staleness, same trade as the emulated
+        pipelined round)."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -877,6 +894,10 @@ class DistributedTrainer(Trainer):
         self.ps_address = (None if ps_address is None
                            else (str(ps_address[0]),
                                  int(ps_address[1])))
+        self.ps_shards = int(ps_shards)
+        if self.ps_shards < 1:
+            raise ValueError(
+                f"ps_shards must be >= 1, got {ps_shards}")
         self.ps_snapshot_path = ps_snapshot_path
         self.ps_snapshot_every = int(ps_snapshot_every)
         if fidelity != "host" and (self.max_worker_failures
@@ -885,12 +906,13 @@ class DistributedTrainer(Trainer):
                                    or fault_injector is not None
                                    or compression is not None
                                    or ps_address is not None
+                                   or self.ps_shards > 1
                                    or ps_snapshot_path is not None
                                    or self.ps_snapshot_every):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
                 "/ fault_injector / compression / ps_address / "
-                "ps_snapshot_* apply only to "
+                "ps_shards / ps_snapshot_* apply only to "
                 "fidelity='host' (the emulated arms are deterministic; "
                 "recover via checkpoint/resume), got "
                 f"fidelity={fidelity!r}")
@@ -908,12 +930,14 @@ class DistributedTrainer(Trainer):
                 "on the externally created HostParameterServer, not "
                 "on the trainer (the driver does not own the server)")
         self.commit_overlap = bool(commit_overlap)
-        if self.commit_overlap and fidelity != "faithful":
+        if self.commit_overlap and fidelity not in ("faithful",
+                                                    "host"):
             raise ValueError(
-                "commit_overlap pipelines the faithful commit scan "
-                "against the next window; it requires "
-                "fidelity='faithful' (the fast/host arms have no "
-                f"separate commit phase to overlap), got {fidelity!r}")
+                "commit_overlap pipelines the commit against the next "
+                "window; it requires fidelity='faithful' (pipelined "
+                "round scan) or fidelity='host' (double-buffered "
+                "worker loop) — the fast arm has no separate commit "
+                f"phase to overlap, got fidelity={fidelity!r}")
         if self.commit_overlap and (checkpoint_every_rounds
                                     or kwargs.get("checkpoint_dir")):
             raise ValueError(
@@ -1459,6 +1483,19 @@ class DistributedTrainer(Trainer):
                 "(DOWNPOUR/ADAG/DynSGD): their additive payloads are "
                 "error-feedback-correctable; the elastic family "
                 "commits absolute parameters")
+        if self.ps_shards > 1 and rule.payload_kind != "delta":
+            raise ValueError(
+                "ps_shards > 1 applies to the delta-family rules "
+                "(per-leaf additive updates shard safely); the "
+                "elastic exchange reads the worker's whole local tree "
+                "against one consistent center — pin it to "
+                "ps_shards=1")
+        if self.commit_overlap and rule.payload_kind != "delta":
+            raise ValueError(
+                "commit_overlap on the host arm supports the delta "
+                "family only (the elastic exchange folds the pulled "
+                "center back into the worker's CURRENT locals — "
+                "nothing to overlap)")
         tx = self._tx()
         variables = self._init_variables(initial_variables)
         center = variables["params"]
@@ -1489,12 +1526,31 @@ class DistributedTrainer(Trainer):
                     "external ps_address does not compose with "
                     "multi-host runs (process 0 hosts the PS there)")
 
+        shard_plan = None
+        if self.ps_shards > 1:
+            from distkeras_tpu.parallel.sharded_ps import plan_shards
+
+            # the one plan every endpoint derives: byte-balanced leaf
+            # partition, a pure function of (template, K)
+            shard_plan = plan_shards(
+                jax.tree_util.tree_map(np.asarray, center),
+                self.ps_shards)
+
         ps = None
         server = None
         if self.ps_address is None and (not multi or rank == 0):
-            ps = HostParameterServer(
-                rule, center, snapshot_path=self.ps_snapshot_path,
-                snapshot_every=self.ps_snapshot_every)
+            if self.ps_shards > 1:
+                from distkeras_tpu.parallel.sharded_ps import (
+                    ShardedParameterServer)
+
+                ps = ShardedParameterServer(
+                    rule, center, self.ps_shards,
+                    snapshot_path=self.ps_snapshot_path,
+                    snapshot_every=self.ps_snapshot_every)
+            else:
+                ps = HostParameterServer(
+                    rule, center, snapshot_path=self.ps_snapshot_path,
+                    snapshot_every=self.ps_snapshot_every)
             if self.transport == "socket":
                 server = PSServer(
                     ps, center,
@@ -1545,6 +1601,8 @@ class DistributedTrainer(Trainer):
         failures = telemetry.Series()       # (worker, exception)
         wire_total = telemetry.Counter()    # codec-arm commit bytes
         raw_total = telemetry.Counter()
+        skip_total = telemetry.Counter()    # version-delta pull savings
+        saved_total = telemetry.Counter()   # (sharded socket arm)
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -1692,13 +1750,43 @@ class DistributedTrainer(Trainer):
                             seed=self.seed + 101 * w,
                             on_retry=on_retry)
             socket_arm = ps_address is not None
+            sharded_socket = socket_arm and self.ps_shards > 1
+            # per-worker, so client instances (rebuilt per reconnect)
+            # accumulate race-free; folded into the shared counters
+            # in the finally below
+            shard_stats = ({"pull_shards_skipped": 0,
+                            "pull_bytes_saved": 0}
+                           if sharded_socket else None)
             if socket_arm:
                 client = ResilientPSClient.for_address(
                     *ps_address, worker_id=w, template=center,
-                    codec=codec, **retry_kw)
+                    codec=codec, shards=self.ps_shards,
+                    shard_stats=shard_stats, **retry_kw)
             else:
                 client = ResilientPSClient.for_server(ps, w,
                                                       **retry_kw)
+            overlap = self.commit_overlap
+            exchange = None
+            pending: list = [None]
+            if overlap:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # one-deep double buffer: the exchange for window n
+                # runs here while the device computes window n+1 (the
+                # worker trains one exchange behind — +1 staleness)
+                exchange = ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"dkt-ps-exchange-{w}")
+
+            def drain_exchange():
+                """Join the in-flight exchange (if any) and adopt its
+                pulled center; every synchronous client op must be
+                preceded by this (one connection, one op at a time).
+                Raises what the exchange raised (PSRetryExhausted
+                included)."""
+                fut, pending[0] = pending[0], None
+                return fut.result() if fut is not None else None
+
             wire_bytes = raw_bytes = 0
             try:
                 state = TrainState.create(
@@ -1782,22 +1870,44 @@ class DistributedTrainer(Trainer):
                                         # server absorbed.
                                         total = tree_add(payload,
                                                          residual)
-                                        encoded, applied = (
-                                            codec.round_trip(total))
-                                        pulled = client.commit(
+                                        if sharded_socket:
+                                            encoded, applied = (
+                                                codec.round_trip_shards(
+                                                    total, shard_plan))
+                                            enc_len = sum(
+                                                len(d) for d in encoded)
+                                        else:
+                                            encoded, applied = (
+                                                codec.round_trip(total))
+                                            enc_len = len(encoded)
+                                        commit_args = (
                                             encoded if socket_arm
                                             else applied, None)
                                         residual = tree_sub(total,
                                                             applied)
-                                        wire_bytes += len(encoded)
+                                        wire_bytes += enc_len
                                         raw_bytes += raw_nbytes(
                                             payload)
                                     else:
-                                        pulled = client.commit(
+                                        commit_args = (
                                             payload,
                                             local
                                             if rule.pull_uses_local
                                             else None)
+                                    if overlap:
+                                        # adopt exchange n-1's center
+                                        # (it ran under window n's
+                                        # compute), hand exchange n to
+                                        # the background thread
+                                        got = drain_exchange()
+                                        if got is not None:
+                                            pulled = got
+                                        pending[0] = exchange.submit(
+                                            client.commit,
+                                            *commit_args)
+                                    else:
+                                        pulled = client.commit(
+                                            *commit_args)
                                     break
                                 except PSRetryExhausted:
                                     # the network budget died inside
@@ -1822,6 +1932,12 @@ class DistributedTrainer(Trainer):
                                                       worker=w,
                                                       epoch=epoch,
                                                       round=r)
+                                    if overlap:
+                                        # serialize with the in-flight
+                                        # exchange before re-pulling
+                                        # (its PSRetryExhausted, if
+                                        # any, kills the worker here)
+                                        drain_exchange()
                                     pulled = client.pull()
                             round_records.append(
                                 (w, epoch,
@@ -1838,12 +1954,18 @@ class DistributedTrainer(Trainer):
                             f"worker {w}: not enough batches per "
                             f"worker for one communication window "
                             f"({window}) in any segment")
+                if overlap:
+                    # the last window's exchange is still in flight;
+                    # its center must land before the clean finish
+                    drain_exchange()
                 client.done()
                 client.close()
             except BaseException as e:  # handled by the join below
                 note_death(w)
                 failures.append((w, e))
             finally:
+                if exchange is not None:
+                    exchange.shutdown(wait=False)
                 # telemetry flush runs even for workers that die
                 # mid-run — their applied commits' traffic was real
                 if codec is not None:
@@ -1852,6 +1974,9 @@ class DistributedTrainer(Trainer):
                     m = telemetry.metrics()
                     m.counter("commit_wire_bytes_total").inc(wire_bytes)
                     m.counter("commit_raw_bytes_total").inc(raw_bytes)
+                if shard_stats is not None:
+                    skip_total.inc(shard_stats["pull_shards_skipped"])
+                    saved_total.inc(shard_stats["pull_bytes_saved"])
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in local_workers]
@@ -1929,6 +2054,13 @@ class DistributedTrainer(Trainer):
         if codec is not None:
             self._record(commit_wire_bytes=int(wire_total.value),
                          commit_raw_bytes=int(raw_total.value))
+        if self.ps_shards > 1 and self.transport == "socket":
+            # version-delta pull savings (process-local): shards the
+            # server did NOT ship because this process's workers were
+            # already current on them
+            self._record(
+                pull_shards_skipped=int(skip_total.value),
+                pull_bytes_saved=int(saved_total.value))
 
         # round_loss is per-process telemetry (this process's workers);
         # epoch_loss / dropped tails are reduced globally so every

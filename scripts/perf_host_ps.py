@@ -16,6 +16,14 @@ wall-time instrumented, for window in {1, 4, 16} x {raw, int8}.
 Reports rows/sec and the fraction of worker wall-time spent inside the
 PS exchange (the "worker-stall fraction").
 
+Part 4 — sharded-PS A/B (PERF.md §25): ``ShardedParameterServer`` over
+the shard-addressed zero-copy wire vs the single-mutex ``PSServer``
+baseline, K ∈ {1, 2, 4, 8} x workers ∈ {2, 4, 8} hammering full-tree
+commits at ResNet-18 scale, plus a stale-polling reader measuring the
+version-delta pull's wire-byte savings.  ``--smoke`` runs a seconds-
+scale arm at MLP scale with parity/savings assertions (tier-1 via
+test_examples.py SMOKE_SCRIPTS).
+
 Run on CPU (the host arm's per-thread device programs are plain convs —
 no vmapped-conv slow path), so the wire path is measured without the
 TPU tunnel's 11 MB/s transfer distortion:
@@ -220,6 +228,188 @@ def part3_cross_host(window=16, workers=4, rows=None):
         print(json.dumps(out), flush=True)
 
 
+def _hammer_commits(center, num_shards, workers, commits,
+                    use_seq=True):
+    """One A/B cell: ``workers`` threads, each loop = one full-tree
+    delta commit against a freshly-built server; K=1 is the single-
+    mutex ``HostParameterServer`` + ``pack_params`` wire (the
+    baseline), K>1 the ``ShardedParameterServer`` over the
+    shard-addressed scatter-gather wire.  Returns commits/sec."""
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSClient, PSServer)
+    from distkeras_tpu.parallel.sharded_ps import (
+        ShardedParameterServer, ShardedPSClient)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    delta = jax.tree_util.tree_map(
+        lambda x: (0.001 * np.ones_like(x)), center)
+    if num_shards > 1:
+        ps = ShardedParameterServer(DownpourRule(), center, num_shards)
+    else:
+        ps = HostParameterServer(DownpourRule(), center)
+    server = PSServer(ps, center).start()
+    host, port = server.address
+    barrier = threading.Barrier(workers + 1)
+    errs = []
+
+    def worker(w):
+        try:
+            if num_shards > 1:
+                client = ShardedPSClient(host, port, w, center,
+                                         num_shards=num_shards)
+            else:
+                client = PSClient(host, port, w, center)
+            client.pull()
+            barrier.wait()
+            for s in range(commits):
+                client.commit(delta, seq=s if use_seq else None)
+            client.close()
+        except Exception as e:  # surfaced after join
+            errs.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    server.stop()
+    if errs:
+        raise errs[0]
+    return commits * workers / dt
+
+
+def part4_sharded_ab(center, commits=6, shards_list=(1, 2, 4, 8),
+                     workers_list=(2, 4, 8)):
+    """The §25 grid: sharded commit throughput vs the single-mutex
+    baseline, per (K, workers); the baseline row is K=1."""
+    results = {}
+    for workers in workers_list:
+        for k in shards_list:
+            cps = _hammer_commits(center, k, workers, commits)
+            results[(k, workers)] = cps
+            base = results.get((1, workers))
+            print(json.dumps({
+                "bench": "ps_sharded", "shards": k, "workers": workers,
+                "commits_per_sec": round(cps, 2),
+                "speedup_vs_mutex": (round(cps / base, 2)
+                                     if base else 1.0),
+            }), flush=True)
+    return results
+
+
+def part4_version_delta(center, num_shards=4, commit_rounds=6,
+                        polls_per_round=4):
+    """Stale-polling reader: a writer commits full-tree deltas while a
+    reader pulls ``polls_per_round`` times per commit — the version-
+    delta wire ships only shards whose clock advanced, so most polls
+    cost a 2-byte header instead of the full parameter set."""
+    from distkeras_tpu.parallel.host_ps import PSServer
+    from distkeras_tpu.parallel.sharded_ps import (
+        ShardedParameterServer, ShardedPSClient, leaf_nbytes)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    delta = jax.tree_util.tree_map(
+        lambda x: (0.001 * np.ones_like(x)), center)
+    full_bytes = leaf_nbytes(jax.tree_util.tree_leaves(center))
+    ps = ShardedParameterServer(DownpourRule(), center, num_shards)
+    server = PSServer(ps, center).start()
+    host, port = server.address
+    writer = ShardedPSClient(host, port, 0, center,
+                             num_shards=num_shards)
+    stats = {}
+    reader = ShardedPSClient(host, port, 1, center,
+                             num_shards=num_shards, stats=stats)
+    writer.pull()
+    reader.pull()  # first pull is always full (empty cache)
+    for s in range(commit_rounds):
+        writer.commit(delta, seq=s)
+        for _ in range(polls_per_round):
+            reader.pull()
+    polls = commit_rounds * polls_per_round
+    naive = polls * full_bytes
+    shipped = naive - stats["pull_bytes_saved"]
+    out = {
+        "bench": "ps_version_delta", "shards": num_shards,
+        "polls": polls, "full_pull_mb": round(full_bytes / 1e6, 2),
+        "naive_mb": round(naive / 1e6, 1),
+        "shipped_mb": round(shipped / 1e6, 1),
+        "bytes_saved_frac": round(stats["pull_bytes_saved"] / naive,
+                                  3),
+        "shards_skipped": stats["pull_shards_skipped"],
+    }
+    print(json.dumps(out), flush=True)
+    writer.close()
+    reader.close()
+    server.stop()
+    return out
+
+
+def _smoke_center(leaves=12, rows=64):
+    rng = np.random.default_rng(0)
+    return {f"w{i}": rng.normal(size=(rows, 8 + i)).astype(np.float32)
+            for i in range(leaves)}
+
+
+def smoke():
+    """Seconds-scale correctness + direction check of the sharded PS
+    (tier-1; the measured §25 numbers come from the full parts)."""
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSServer)
+    from distkeras_tpu.parallel.sharded_ps import (
+        ShardedParameterServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    center = _smoke_center()
+    # parity: identical serial schedule through both servers
+    deltas = [jax.tree_util.tree_map(
+        lambda x: ((i + 1) * 1e-3 * np.ones_like(x)), center)
+        for i in range(4)]
+    ref = HostParameterServer(DownpourRule(), center)
+    sha = ShardedParameterServer(DownpourRule(), center, 2)
+    for ps in (ref, sha):
+        for w in range(2):
+            ps.pull(w)
+        for i, d in enumerate(deltas):
+            ps.commit(i % 2, d, seq=i // 2)
+    for k in center:
+        np.testing.assert_array_equal(np.asarray(ref.center[k]),
+                                      np.asarray(sha.center[k]))
+    assert ref.staleness_log == sha.staleness_log
+    print(json.dumps({"bench": "smoke_parity", "ok": True}),
+          flush=True)
+    # wire throughput runs (no assertion on the ratio at smoke scale)
+    for k in (1, 2):
+        cps = _hammer_commits(center, k, workers=2, commits=3)
+        print(json.dumps({"bench": "smoke_sharded", "shards": k,
+                          "commits_per_sec": round(cps, 1)}),
+              flush=True)
+    # version-delta pulls must actually save bytes
+    out = part4_version_delta(center, num_shards=2, commit_rounds=2,
+                              polls_per_round=3)
+    assert out["bytes_saved_frac"] > 0.5, out
+    # sharded kill/warm-restart keeps the center byte-identical
+    sha2 = ShardedParameterServer.from_snapshot(DownpourRule(),
+                                               sha.snapshot())
+    for k in center:
+        np.testing.assert_array_equal(np.asarray(sha.center[k]),
+                                      np.asarray(sha2.center[k]))
+    print(json.dumps({"bench": "smoke_restart", "ok": True}),
+          flush=True)
+    # a PSServer restarted from that snapshot serves it
+    srv = PSServer.restart_from(sha.snapshot(), DownpourRule(), center)
+    assert srv.ps.num_shards == 2
+    srv.stop()
+    print(json.dumps({"smoke": "ok"}), flush=True)
+
+
 def part3_child(args):
     """One process of the cross-host arm (invoked by part3 via
     run_multiprocess)."""
@@ -266,12 +456,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--commits", type=int, default=8)
     ap.add_argument("--rows", type=int, default=None)
-    ap.add_argument("--part", choices=["1", "2", "3", "both", "child"],
+    ap.add_argument("--part",
+                    choices=["1", "2", "3", "4", "both", "child"],
                     default="both")
     ap.add_argument("--window", type=int, default=16)
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--codec", default="raw")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale sharded-PS correctness arm "
+                         "(tier-1)")
     args = ap.parse_args()
+    if args.smoke:
+        smoke()
+        return
     if args.part == "child":
         part3_child(args)
         return
@@ -285,6 +482,9 @@ def main():
     if args.part == "3":
         part3_cross_host(window=args.window, workers=args.workers,
                          rows=args.rows)
+    if args.part == "4":
+        part4_sharded_ab(center, commits=args.commits)
+        part4_version_delta(center)
 
 
 if __name__ == "__main__":

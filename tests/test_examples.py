@@ -26,6 +26,7 @@ SCRIPTS = REPO / "scripts"
 SMOKE_SCRIPTS = {
     "chaos_report.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
+    "perf_host_ps.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],
 }

@@ -167,3 +167,27 @@ def test_chaos_sweep_completes_within_budget_exactly_once(fault):
     if fault != "delay":  # delays cost time, not retries
         assert t.history.get("worker_round_retries"), (
             "disruptive chaos left no retry trace")
+
+
+@pytest.mark.parametrize("fault", sorted(SWEEP))
+def test_chaos_sweep_against_sharded_server(fault):
+    """The same seeded sweep with the SHARDED PS (ISSUE 4 acceptance):
+    the shard-addressed scatter-gather wire crosses the same chaos
+    choke point, a logical commit's seq dedupes per shard, and
+    at-most-once holds — applied logical commits == completed rounds
+    even when a failure lands between two shard commits."""
+    with ChaosTransport(seed=11, **SWEEP[fault]) as ct:
+        t = DOWNPOUR(MLP, fidelity="host", transport="socket",
+                     ps_shards=2, num_workers=2,
+                     communication_window=2, batch_size=16,
+                     num_epoch=1, learning_rate=0.01,
+                     worker_optimizer="adam", worker_retries=10)
+        t.train(DATA)
+    assert ct.counts[fault] > 0, ct.counts
+    assert "worker_failures" not in t.history
+    assert np.isfinite(t.history["epoch_loss"]).all()
+    ps = t.parameter_server_state
+    assert ps.num_commits == len(t.history["round_loss"])
+    # every shard saw every logical commit exactly once
+    assert [s.num_commits for s in ps._shards] == \
+        [ps.num_commits] * ps.num_shards

@@ -97,6 +97,13 @@ def _collect_emitted() -> set[str]:
                      compression="int8",
                      ps_snapshot_path=f"{d}/ps.snap",
                      ps_snapshot_every=4))
+
+    # sharded host arm over the socket wire: the version-delta pull
+    # savings keys (ISSUE 4)
+    run(DOWNPOUR(MLP, fidelity="host", transport="socket", ps_shards=2,
+                 num_workers=2, communication_window=2, batch_size=16,
+                 num_epoch=1, learning_rate=0.01,
+                 commit_overlap=True))
     return emitted
 
 
@@ -113,7 +120,8 @@ def test_every_emitted_history_key_is_documented():
             "segment_stall_s", "dropped_tail_batches",
             "skipped_segment_rows", "eval_accuracy", "member_loss",
             "worker_failures", "worker_round_retries",
-            "commit_wire_bytes", "commit_raw_bytes", "ps_snapshots"}
+            "commit_wire_bytes", "commit_raw_bytes", "ps_snapshots",
+            "pull_shards_skipped", "pull_bytes_saved"}
     missing = core - emitted
     assert not missing, (
         f"collection no longer exercises core history keys: "
