@@ -106,20 +106,32 @@ def ps_snapshot_info(path: str | os.PathLike) -> dict:
     ``PSServer.restart_from``'s dispatch (an unsharded
     ``HostParameterServer`` snapshot has no ``"sharded"`` key; a
     ``ShardedParameterServer`` snapshot carries the shard count plus
-    per-shard clock/dedupe sections)."""
+    per-shard clock/dedupe sections).  ``last_acked`` maps worker id
+    (str) → highest commit seq the snapshot proves acknowledged — the
+    postmortem's cross-check key against the flight recorder (on a
+    sharded snapshot that is the MIN across shards: a logical commit
+    is acked only once its last shard replied)."""
     snap = load_ps_snapshot(path)
     if "sharded" in snap:
         shards = snap["shards"]
+        acked: dict[str, int] = {}
+        for s in shards:
+            for w, e in s["last_reply"].items():
+                seq = int(e["seq"])
+                acked[w] = min(acked.get(w, seq), seq)
         return {
             "sharded": int(snap["sharded"]),
             "num_commits": int(shards[0]["num_commits"]),
             "workers_cached": len({w for s in shards
                                    for w in s["last_reply"]}),
+            "last_acked": acked,
         }
     return {
         "sharded": None,
         "num_commits": int(snap["num_commits"]),
         "workers_cached": len(snap["last_reply"]),
+        "last_acked": {w: int(e["seq"])
+                       for w, e in snap["last_reply"].items()},
     }
 
 

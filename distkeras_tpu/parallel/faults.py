@@ -50,10 +50,15 @@ from typing import Optional
 
 import numpy as np
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import flight_recorder, telemetry
 from distkeras_tpu.parallel import transport
 
 KINDS = ("reset", "truncate", "delay", "partition")
+
+# serializes install/uninstall across instances and threads: the
+# module-binding swap must be atomic with the "are the current
+# bindings mine?" check in ``uninstall``
+_install_lock = threading.Lock()
 
 
 class ChaosTransport:
@@ -112,6 +117,9 @@ class ChaosTransport:
         self.counts[kind] += 1
         telemetry.metrics().counter("chaos_injected_total",
                                     kind=kind).inc()
+        # called under self._lock, so op index matches the draw that
+        # scheduled this injection
+        flight_recorder.record("chaos", fault=kind, op=self._op)
 
     def _draw(self, op_kind: str):
         """One scheduled decision; returns the fault to inject (or
@@ -231,30 +239,44 @@ class ChaosTransport:
     # -- install / uninstall ----------------------------------------------
 
     def install(self) -> "ChaosTransport":
-        if self._installed:
-            raise RuntimeError("ChaosTransport already installed")
-        self._orig = (transport.connect, transport.send_msg,
-                      transport.recv_msg, transport.send_msg_gather,
-                      transport.recv_msg_into)
-        self._installed = True
-        transport.connect = self._connect
-        transport.send_msg = self._send_msg
-        transport.recv_msg = self._recv_msg
-        transport.send_msg_gather = self._send_msg_gather
-        transport.recv_msg_into = self._recv_msg_into
+        with _install_lock:
+            if self._installed:
+                raise RuntimeError("ChaosTransport already installed")
+            self._orig = (transport.connect, transport.send_msg,
+                          transport.recv_msg,
+                          transport.send_msg_gather,
+                          transport.recv_msg_into)
+            self._installed = True
+            transport.connect = self._connect
+            transport.send_msg = self._send_msg
+            transport.recv_msg = self._recv_msg
+            transport.send_msg_gather = self._send_msg_gather
+            transport.recv_msg_into = self._recv_msg_into
         return self
 
     def uninstall(self) -> None:
-        if not self._installed:
-            return
-        (transport.connect, transport.send_msg, transport.recv_msg,
-         transport.send_msg_gather, transport.recv_msg_into) = (
-            self._orig)
-        self._installed = False
-        # self._orig is deliberately KEPT: a daemon PS handler thread
-        # may still be inside a wrapper (blocked on recv) when the
-        # module bindings are restored — it must find the originals,
-        # not a None
+        """Restore the transport bindings.  Idempotent — a second (or
+        concurrent) ``uninstall`` is a no-op, and an instance whose
+        wrappers have already been replaced (another injector stacked
+        on top, or a test monkeypatch) restores NOTHING rather than
+        clobbering the newer bindings with its stale snapshot."""
+        with _install_lock:
+            if not self._installed:
+                return
+            self._installed = False
+            mine = (self._connect, self._send_msg, self._recv_msg,
+                    self._send_msg_gather, self._recv_msg_into)
+            current = (transport.connect, transport.send_msg,
+                       transport.recv_msg, transport.send_msg_gather,
+                       transport.recv_msg_into)
+            if current == mine:
+                (transport.connect, transport.send_msg,
+                 transport.recv_msg, transport.send_msg_gather,
+                 transport.recv_msg_into) = self._orig
+            # self._orig is deliberately KEPT: a daemon PS handler
+            # thread may still be inside a wrapper (blocked on recv)
+            # when the module bindings are restored — it must find the
+            # originals, not a None
 
     def __enter__(self) -> "ChaosTransport":
         return self.install()

@@ -30,6 +30,7 @@ Two transports:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import socket
 import threading
@@ -39,7 +40,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import flight_recorder, telemetry
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 
@@ -213,6 +214,8 @@ class HostParameterServer:
                 if last is not None and seq <= last[0]:
                     self._last_seen[worker_id] = telemetry.now()
                     m.counter("ps_commit_dedup_total").inc()
+                    flight_recorder.record("commit_dedup",
+                                           worker=worker_id, seq=seq)
                     return unpack_params(self._center, last[1])
             staleness = self._clock - self._pull_clock.get(worker_id, 0)
             state = PSState(center=self._center,
@@ -233,6 +236,9 @@ class HostParameterServer:
             m.histogram("ps_commit_staleness",
                         buckets=telemetry.STALENESS_BUCKETS
                         ).observe(int(staleness))
+            flight_recorder.record("commit", worker=worker_id, seq=seq,
+                                   clock=self._clock,
+                                   staleness=int(staleness))
             pulled = _to_numpy(pulled)
             if seq is not None:
                 self._cache_reply_locked(worker_id, seq,
@@ -283,6 +289,8 @@ class HostParameterServer:
         ``idle_workers`` instead of being invisible."""
         with self._lock:
             self._last_seen.setdefault(worker_id, telemetry.now())
+            n = len(self._last_seen)
+        telemetry.metrics().gauge("ps_registered_workers").set(n)
 
     def retire(self, worker_id: int) -> None:
         """A worker finished cleanly: stop monitoring it (so
@@ -317,8 +325,19 @@ class HostParameterServer:
         with self._lock:
             idle = sorted(w for w, seen in self._last_seen.items()
                           if now - seen > timeout)
+            n = len(self._last_seen)
         telemetry.metrics().gauge("ps_idle_workers").set(len(idle))
+        telemetry.metrics().gauge("ps_registered_workers").set(n)
         return idle
+
+    def last_acked_seqs(self) -> dict[int, int]:
+        """Per-worker last acked commit seq — the dedupe table's view,
+        i.e. the at-most-once state a warm restart carries forward.
+        ``scripts/postmortem.py`` cross-checks this against the flight
+        recorder's pre-crash record."""
+        with self._lock:
+            return {int(w): int(seq)
+                    for w, (seq, _) in self._last_reply.items()}
 
     # -- snapshot / warm restart ------------------------------------------
 
@@ -354,6 +373,11 @@ class HostParameterServer:
                                   self._snapshot_locked())
         self.num_snapshots += 1
         telemetry.metrics().counter("ps_snapshots_total").inc()
+        flight_recorder.record(
+            "snapshot", path=os.fspath(self._snapshot_path),
+            num_commits=self.num_commits,
+            last_acked={str(w): int(seq)
+                        for w, (seq, _) in self._last_reply.items()})
 
     def save_snapshot(self, path: str | os.PathLike) -> str:
         """Write ``snapshot()`` atomically (``checkpoint`` machinery:
@@ -496,85 +520,25 @@ class PSServer:
                 while True:
                     msg = transport.recv_msg_into(conn)
                     rx.inc(len(msg))
+                    # optional 17-byte trace-context header (zero bytes
+                    # when client tracing is off): link the handler
+                    # span back to the client span and complete the
+                    # client→server flow arrow
+                    link, msg = transport.split_trace_header(msg)
                     cmd, body = bytes(msg[:1]), msg[1:]
-                    if cmd == b"p":
-                        wire = pack_params(
-                            self.ps.pull(worker_id), self._template)
-                        tx.inc(len(wire))
-                        transport.send_msg(conn, wire)
-                    elif cmd == b"c":
-                        seq = int.from_bytes(body[:8], "big")
-                        if seq == _NO_SEQ:
-                            seq = None
-                        if codec is not None:
-                            payload = codec.decode(body[8:],
-                                                   self._template)
-                        else:
-                            payload = unpack_params(
-                                self._template, body[8:])
-                        local = None
-                        if self.ps.rule.pull_uses_local:
-                            raw = transport.recv_msg(conn)
-                            rx.inc(len(raw))
-                            local = unpack_params(self._template, raw)
-                        if hasattr(self.ps, "commit_packed"):
-                            # single pack, shared with the dedupe cache
-                            wire = self.ps.commit_packed(
-                                worker_id, payload, local, seq=seq)
-                        else:
-                            wire = pack_params(
-                                self.ps.commit(worker_id, payload,
-                                               local, seq=seq),
-                                self._template)
-                        tx.inc(len(wire))
-                        transport.send_msg(conn, wire)
-                    elif cmd == b"P" and self._sharded:
-                        from distkeras_tpu.parallel.sharded_ps import (
-                            leaf_buffers)
-
-                        k = self.ps.num_shards
-                        since = [int.from_bytes(body[8 * i:8 * i + 8],
-                                                "big")
-                                 for i in range(k)]
-                        included, _, _ = self.ps.pull_since(worker_id,
-                                                            since)
-                        head = len(included).to_bytes(2, "big") + \
-                            b"".join(s.to_bytes(2, "big")
-                                     + c.to_bytes(8, "big")
-                                     for s, c, _ in included)
-                        parts = [head]
-                        for s, _, leaves in included:
-                            parts.extend(leaf_buffers(
-                                leaves, self._shard_templates[s]))
-                        tx.inc(transport.send_msg_gather(conn, *parts))
-                    elif cmd == b"C" and self._sharded:
-                        from distkeras_tpu.parallel.sharded_ps import (
-                            leaf_buffers, unpack_leaves)
-
-                        k = int.from_bytes(body[:2], "big")
-                        seq = int.from_bytes(body[2:10], "big")
-                        if seq == _NO_SEQ:
-                            seq = None
-                        temps = self._shard_templates[k]
-                        if codec is not None:
-                            leaves = codec.decode_leaves(body[10:],
-                                                         temps)
-                        else:
-                            leaves = unpack_leaves(temps, body[10:])
-                        clock, pulled = self.ps.commit_shard(
-                            worker_id, k, leaves, seq=seq)
-                        tx.inc(transport.send_msg_gather(
-                            conn, clock.to_bytes(8, "big"),
-                            *leaf_buffers(pulled, temps)))
-                    elif cmd == b"d":
-                        # clean worker finish: retire from liveness
-                        # monitoring and drop its dedupe reply
-                        self.ps.retire(worker_id)
-                    elif cmd == b"s":
-                        self._stop.set()
-                        return
-                    else:
-                        raise ValueError(f"unknown command {cmd!r}")
+                    with contextlib.ExitStack() as rpc:
+                        if link is not None:
+                            rpc.enter_context(telemetry.span(
+                                "ps_rpc", cmd=cmd.decode(),
+                                worker=worker_id,
+                                link_trace=format(link[0], "x"),
+                                link_span=format(link[1], "x")))
+                            telemetry.flow_end("wire", link[1],
+                                               cmd=cmd.decode())
+                        self._dispatch(conn, worker_id, codec, cmd,
+                                       body, rx, tx)
+                        if self._stop.is_set():
+                            return
             except (ConnectionError, OSError):
                 return  # client gone; reference handlers did the same
             except Exception as e:
@@ -587,6 +551,81 @@ class PSServer:
                       f"connection dropped): {e!r}", file=sys.stderr,
                       flush=True)
                 return
+
+    def _dispatch(self, conn: socket.socket, worker_id: int, codec,
+                  cmd: bytes, body, rx, tx) -> None:
+        """One request: dispatch ``cmd`` against the PS and reply.
+        Split from ``_serve`` so the trace-linked rpc span can wrap
+        exactly one request."""
+        if cmd == b"p":
+            wire = pack_params(
+                self.ps.pull(worker_id), self._template)
+            tx.inc(len(wire))
+            transport.send_msg(conn, wire)
+        elif cmd == b"c":
+            seq = int.from_bytes(body[:8], "big")
+            if seq == _NO_SEQ:
+                seq = None
+            if codec is not None:
+                payload = codec.decode(body[8:], self._template)
+            else:
+                payload = unpack_params(self._template, body[8:])
+            local = None
+            if self.ps.rule.pull_uses_local:
+                raw = transport.recv_msg(conn)
+                rx.inc(len(raw))
+                local = unpack_params(self._template, raw)
+            if hasattr(self.ps, "commit_packed"):
+                # single pack, shared with the dedupe cache
+                wire = self.ps.commit_packed(
+                    worker_id, payload, local, seq=seq)
+            else:
+                wire = pack_params(
+                    self.ps.commit(worker_id, payload, local, seq=seq),
+                    self._template)
+            tx.inc(len(wire))
+            transport.send_msg(conn, wire)
+        elif cmd == b"P" and self._sharded:
+            from distkeras_tpu.parallel.sharded_ps import leaf_buffers
+
+            k = self.ps.num_shards
+            since = [int.from_bytes(body[8 * i:8 * i + 8], "big")
+                     for i in range(k)]
+            included, _, _ = self.ps.pull_since(worker_id, since)
+            head = len(included).to_bytes(2, "big") + \
+                b"".join(s.to_bytes(2, "big") + c.to_bytes(8, "big")
+                         for s, c, _ in included)
+            parts = [head]
+            for s, _, leaves in included:
+                parts.extend(leaf_buffers(
+                    leaves, self._shard_templates[s]))
+            tx.inc(transport.send_msg_gather(conn, *parts))
+        elif cmd == b"C" and self._sharded:
+            from distkeras_tpu.parallel.sharded_ps import (
+                leaf_buffers, unpack_leaves)
+
+            k = int.from_bytes(body[:2], "big")
+            seq = int.from_bytes(body[2:10], "big")
+            if seq == _NO_SEQ:
+                seq = None
+            temps = self._shard_templates[k]
+            if codec is not None:
+                leaves = codec.decode_leaves(body[10:], temps)
+            else:
+                leaves = unpack_leaves(temps, body[10:])
+            clock, pulled = self.ps.commit_shard(
+                worker_id, k, leaves, seq=seq)
+            tx.inc(transport.send_msg_gather(
+                conn, clock.to_bytes(8, "big"),
+                *leaf_buffers(pulled, temps)))
+        elif cmd == b"d":
+            # clean worker finish: retire from liveness monitoring and
+            # drop its dedupe reply
+            self.ps.retire(worker_id)
+        elif cmd == b"s":
+            self._stop.set()
+        else:
+            raise ValueError(f"unknown command {cmd!r}")
 
     def stop(self):
         self._stop.set()
@@ -603,6 +642,12 @@ class PSServer:
         (the dedupe cache is not cleared — a real crash would not
         either; durable state is whatever the snapshots hold).  Clients
         see ``ConnectionError`` and retry against ``restart_from``."""
+        # kill-path flight record, fsynced BEFORE the sockets die: the
+        # postmortem's crash marker must survive whatever follows
+        flight_recorder.record(
+            "ps_kill", port=self.address[1],
+            num_commits=int(getattr(self.ps, "num_commits", 0)))
+        flight_recorder.flush(fsync=True)
         self._stop.set()
         for s in (self._sock, *self._conns):
             try:
@@ -642,6 +687,10 @@ class PSServer:
                 snapshot_every=snapshot_every)
         telemetry.metrics().counter("ps_restarts_total").inc()
         telemetry.instant("ps_restart", commits=ps.num_commits)
+        flight_recorder.record(
+            "ps_restart", num_commits=int(ps.num_commits),
+            last_acked={str(w): s
+                        for w, s in ps.last_acked_seqs().items()})
         return cls(ps, template, host=host, port=port).start()
 
     def __enter__(self):
@@ -666,6 +715,7 @@ class PSClient:
         self._sock = transport.connect(host, port, timeout=30.0)
         self._template = _to_numpy(template)
         self.codec = resolve_codec(codec)
+        self.worker_id = int(worker_id)
         hello = int(worker_id).to_bytes(4, "big")
         if self.codec is not None:
             # The wire carries only the codec NAME; the server decodes
@@ -688,9 +738,19 @@ class PSClient:
         transport.send_msg(self._sock, hello)
 
     def pull(self) -> Pytree:
-        transport.send_msg(self._sock, b"p")
-        return unpack_params(self._template,
-                             transport.recv_msg(self._sock))
+        # the span pushes trace context; trace_header() reads it back,
+        # so the wire carries (trace_id, span_id) only while tracing —
+        # hdr is b"" (zero wire bytes) when telemetry is off
+        with telemetry.span("ps_client_pull",
+                            worker=self.worker_id) as sp:
+            hdr = transport.trace_header()
+            transport.send_msg(self._sock, hdr + b"p")
+            if hdr:
+                # arrow tail AFTER a successful send: an arrow exists
+                # only for requests that actually left this process
+                telemetry.flow_start("wire", sp.span_id, op="pull")
+            return unpack_params(self._template,
+                                 transport.recv_msg(self._sock))
 
     def commit(self, payload: Pytree, local: Pytree | None = None,
                seq: int | None = None) -> Pytree:
@@ -715,14 +775,21 @@ class PSClient:
             body = self.codec.encode(payload)
         else:
             body = pack_params(_to_numpy(payload), self._template)
-        transport.send_msg(self._sock,
-                           b"c" + wire_seq.to_bytes(8, "big"), body)
-        if local is not None:
-            transport.send_msg(self._sock,
-                               pack_params(_to_numpy(local),
-                                           self._template))
-        return unpack_params(self._template,
-                             transport.recv_msg(self._sock))
+        with telemetry.span("ps_client_commit", worker=self.worker_id,
+                            seq=seq) as sp:
+            hdr = transport.trace_header()
+            transport.send_msg(
+                self._sock,
+                hdr + b"c" + wire_seq.to_bytes(8, "big"), body)
+            if local is not None:
+                transport.send_msg(self._sock,
+                                   pack_params(_to_numpy(local),
+                                               self._template))
+            if hdr:
+                telemetry.flow_start("wire", sp.span_id, op="commit",
+                                     seq=seq)
+            return unpack_params(self._template,
+                                 transport.recv_msg(self._sock))
 
     def done(self):
         """Announce a clean finish (retires this worker from the
@@ -792,11 +859,12 @@ class ResilientPSClient:
                  jitter: float = 0.5, seed: int = 0,
                  use_seq: bool = True,
                  on_retry: Optional[Callable[[int, Exception], None]]
-                 = None):
+                 = None, worker: int | None = None):
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
         if not 0.0 <= jitter <= 1.0:
             raise ValueError(f"jitter={jitter} outside [0, 1]")
+        self.worker = worker  # identity for traces / flight records
         self._factory = factory
         self.retries = int(retries)
         self.backoff_base = float(backoff_base)
@@ -821,6 +889,7 @@ class ResilientPSClient:
         ``PSServer``.  Retries are shard-aware for free: the one seq
         stamped per logical commit rides every shard, so a retry after
         a partial application re-applies exactly the missed shards."""
+        kwargs.setdefault("worker", worker_id)
         if shards > 1:
             from distkeras_tpu.parallel.sharded_ps import (
                 ShardedPSClient)
@@ -840,6 +909,7 @@ class ResilientPSClient:
         under the server mutex — no lost-ack window), so dedupe seqs
         default off and no reply cache is kept per worker."""
         kwargs.setdefault("use_seq", False)
+        kwargs.setdefault("worker", worker_id)
         return cls(lambda: _InProcessClient(ps, worker_id), **kwargs)
 
     # -- retry machinery ---------------------------------------------------
@@ -861,36 +931,47 @@ class ResilientPSClient:
                 pass
             self._raw = None
 
-    def _op(self, op: Callable[[Any], Pytree]) -> Pytree:
+    def _op(self, op: Callable[[Any], Pytree],
+            kind: str = "op") -> Pytree:
         attempt = 0
         m = telemetry.metrics()
-        while True:
-            try:
-                if self._raw is None:
-                    self._raw = self._factory()
-                return op(self._raw)
-            except Exception as e:
-                # Exception, not BaseException: KeyboardInterrupt /
-                # MemoryError must not be retried
-                self._close_raw()
-                attempt += 1
-                self.retry_count += 1
-                m.counter("ps_client_retries_total").inc()
-                if attempt > self.retries:
-                    raise PSRetryExhausted(
-                        f"PS operation failed {attempt} time(s); "
-                        f"retry budget {self.retries} exhausted "
-                        f"(last: {e!r})") from e
-                if self.on_retry is not None:
-                    self.on_retry(attempt, e)
-                delay = self._backoff_delay(attempt)
-                m.histogram("ps_client_backoff_seconds").observe(delay)
-                time.sleep(delay)
+        # one span over the WHOLE retry loop: every attempt's
+        # ps_client_commit/pull span nests under it and inherits its
+        # trace id, so a retry storm reads as one causal chain in the
+        # merged trace
+        with telemetry.span("ps_op", op=kind, worker=self.worker):
+            while True:
+                try:
+                    if self._raw is None:
+                        self._raw = self._factory()
+                    return op(self._raw)
+                except Exception as e:
+                    # Exception, not BaseException: KeyboardInterrupt /
+                    # MemoryError must not be retried
+                    self._close_raw()
+                    attempt += 1
+                    self.retry_count += 1
+                    m.counter("ps_client_retries_total").inc()
+                    flight_recorder.record("retry", op=kind,
+                                           worker=self.worker,
+                                           attempt=attempt,
+                                           error=repr(e))
+                    if attempt > self.retries:
+                        raise PSRetryExhausted(
+                            f"PS operation failed {attempt} time(s); "
+                            f"retry budget {self.retries} exhausted "
+                            f"(last: {e!r})") from e
+                    if self.on_retry is not None:
+                        self.on_retry(attempt, e)
+                    delay = self._backoff_delay(attempt)
+                    m.histogram(
+                        "ps_client_backoff_seconds").observe(delay)
+                    time.sleep(delay)
 
     # -- the client face ---------------------------------------------------
 
     def pull(self) -> Pytree:
-        return self._op(lambda c: c.pull())
+        return self._op(lambda c: c.pull(), kind="pull")
 
     def commit(self, payload, local: Pytree | None = None) -> Pytree:
         """At-most-once commit: the seq is stamped once and reused
@@ -898,7 +979,8 @@ class ResilientPSClient:
         either applies it or returns the cached reply), advancing only
         on success."""
         seq = self._seq if self.use_seq else None
-        pulled = self._op(lambda c: c.commit(payload, local, seq=seq))
+        pulled = self._op(lambda c: c.commit(payload, local, seq=seq),
+                          kind="commit")
         self._seq += 1
         return pulled
 

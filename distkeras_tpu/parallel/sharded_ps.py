@@ -58,7 +58,7 @@ from typing import Any, Optional, Sequence
 import jax
 import numpy as np
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import flight_recorder, telemetry
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.host_ps import (
     _NO_SEQ,
@@ -214,6 +214,8 @@ class ShardedParameterServer:
     def register(self, worker_id: int) -> None:
         with self._seen_lock:
             self._last_seen.setdefault(worker_id, telemetry.now())
+            n = len(self._last_seen)
+        telemetry.metrics().gauge("ps_registered_workers").set(n)
 
     def retire(self, worker_id: int) -> None:
         with self._seen_lock:
@@ -230,8 +232,23 @@ class ShardedParameterServer:
         with self._seen_lock:
             idle = sorted(w for w, seen in self._last_seen.items()
                           if now - seen > timeout)
+            n = len(self._last_seen)
         telemetry.metrics().gauge("ps_idle_workers").set(len(idle))
+        telemetry.metrics().gauge("ps_registered_workers").set(n)
         return idle
+
+    def last_acked_seqs(self) -> dict[int, int]:
+        """Per-worker last FULLY-acked logical commit seq: the minimum
+        across shard dedupe tables (a logical commit is acked only when
+        its last shard replied, so a partially-applied commit reports
+        the seq its laggard shards hold)."""
+        out: dict[int, int] = {}
+        for s in self._shards:
+            with s.lock:
+                for w, (seq, _) in s.last_reply.items():
+                    out[int(w)] = min(out.get(int(w), int(seq)),
+                                      int(seq))
+        return out
 
     def clear_reply_cache(self) -> None:
         for shard in self._shards:
@@ -351,6 +368,13 @@ class ShardedParameterServer:
                     s.reply_bytes += len(packed)
                 if shard == self.num_shards - 1:
                     m.counter("ps_commits_total").inc()
+                    # one flight event per LOGICAL commit (its last
+                    # shard), not one per shard — the recorder stays
+                    # proportional to commits
+                    flight_recorder.record(
+                        "commit", worker=worker_id, seq=seq,
+                        clock=s.clock, shards=self.num_shards,
+                        staleness=int(staleness))
                     if (self._snapshot_every and s.num_commits
                             % self._snapshot_every == 0):
                         # the logical commit's other shards applied
@@ -477,10 +501,21 @@ class ShardedParameterServer:
 
         with telemetry.span("ps_snapshot",
                             commits=self._shards[held].num_commits):
-            ckpt.save_ps_snapshot(self._snapshot_path,
-                                  self._snapshot_holding(held))
+            snap = self._snapshot_holding(held)
+            ckpt.save_ps_snapshot(self._snapshot_path, snap)
         self.num_snapshots += 1
         telemetry.metrics().counter("ps_snapshots_total").inc()
+        # fully-acked seq per worker = min across the shard dedupe
+        # tables just captured (same law as ``last_acked_seqs``)
+        acked: dict[str, int] = {}
+        for saved in snap["shards"]:
+            for w, e in saved["last_reply"].items():
+                seq = int(e["seq"])
+                acked[w] = min(acked.get(w, seq), seq)
+        flight_recorder.record(
+            "snapshot", path=os.fspath(self._snapshot_path),
+            num_commits=int(self._shards[0].num_commits),
+            last_acked=acked)
 
     def save_snapshot(self, path: str | os.PathLike) -> str:
         from distkeras_tpu import checkpoint as ckpt
@@ -557,6 +592,7 @@ class ShardedPSClient:
         worker threads to feed history."""
         from distkeras_tpu.parallel.compression import resolve_codec
 
+        self.worker_id = int(worker_id)
         self._template_leaves, self._treedef = \
             jax.tree_util.tree_flatten(_to_numpy(template))
         self._bind_plan(int(num_shards))
@@ -594,8 +630,13 @@ class ShardedPSClient:
     def pull(self) -> Pytree:
         body = b"".join(int(c).to_bytes(8, "big")
                         for c in self._clocks)
-        transport.send_msg(self._sock, b"P", body)
-        reply = transport.recv_msg_into(self._sock)
+        with telemetry.span("ps_client_pull",
+                            worker=self.worker_id) as sp:
+            hdr = transport.trace_header()
+            transport.send_msg(self._sock, hdr + b"P", body)
+            if hdr:
+                telemetry.flow_start("wire", sp.span_id, op="pull")
+            reply = transport.recv_msg_into(self._sock)
         count = int.from_bytes(reply[:2], "big")
         off = 2 + 10 * count
         fresh = set()
@@ -649,19 +690,32 @@ class ShardedPSClient:
                 bodies = [self.codec.encode_leaves(s) for s in shards]
             else:
                 bodies = shards
-        for k, body in enumerate(bodies):
-            head = (b"C" + int(k).to_bytes(2, "big")
-                    + wire_seq.to_bytes(8, "big"))
-            if isinstance(body, (bytes, bytearray)):
-                transport.send_msg_gather(self._sock, head, body)
-            else:
-                transport.send_msg_gather(
-                    self._sock, head,
-                    *leaf_buffers(body, self._shard_templates[k]))
-            reply = transport.recv_msg_into(self._sock)
-            self._clocks[k] = int.from_bytes(reply[:8], "big")
-            self._have[k] = unpack_leaves(self._shard_templates[k],
-                                          reply[8:])
+        with telemetry.span("ps_client_commit",
+                            worker=self.worker_id, seq=seq):
+            for k, body in enumerate(bodies):
+                head = (b"C" + int(k).to_bytes(2, "big")
+                        + wire_seq.to_bytes(8, "big"))
+                # per-shard sub-span: each shard request is its own
+                # wire round trip, so each gets its own flow arrow
+                with telemetry.span("ps_client_shard_commit",
+                                    shard=k) as sp:
+                    hdr = transport.trace_header()
+                    if isinstance(body, (bytes, bytearray)):
+                        transport.send_msg_gather(
+                            self._sock, hdr + head, body)
+                    else:
+                        transport.send_msg_gather(
+                            self._sock, hdr + head,
+                            *leaf_buffers(body,
+                                          self._shard_templates[k]))
+                    if hdr:
+                        telemetry.flow_start(
+                            "wire", sp.span_id, op="shard_commit",
+                            shard=k, seq=seq)
+                    reply = transport.recv_msg_into(self._sock)
+                self._clocks[k] = int.from_bytes(reply[:8], "big")
+                self._have[k] = unpack_leaves(
+                    self._shard_templates[k], reply[8:])
         return self._assemble()
 
     def done(self):

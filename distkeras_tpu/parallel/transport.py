@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 import socket
 import struct
+from typing import Any
 
 _HEADER = struct.Struct(">Q")
 
@@ -151,3 +152,39 @@ def recv_msg(sock: socket.socket) -> bytes:
             f"message length {length} exceeds sanity bound "
             f"{MAX_MSG_BYTES} (DKT_MAX_MSG_BYTES)")
     return _recvall(sock, length)
+
+
+# -- trace-context wire header (ISSUE 6) -------------------------------
+#
+# When tracing is enabled, PS requests prepend a 17-byte header to the
+# frame body: ``b"t" + trace_id(8B BE) + span_id(8B BE)``.  ``b"t"`` is
+# not a PS command byte, so the server peeks one byte to tell a traced
+# request from a bare one — and when tracing is off the header is the
+# EMPTY byte string, adding zero wire bytes (the PERF.md §24 criterion).
+
+_TRACE_HEADER = struct.Struct(">QQ")
+TRACE_HEADER_LEN = 1 + _TRACE_HEADER.size  # magic + two 64-bit ids
+
+
+def trace_header() -> bytes:
+    """The 17-byte trace-context header for the CURRENT thread's
+    innermost live span, or ``b""`` (zero bytes) when no span is open
+    — i.e. always when telemetry is disabled."""
+    from distkeras_tpu import telemetry
+    ctx = telemetry.current_trace()
+    if ctx is None:
+        return b""
+    return b"t" + _TRACE_HEADER.pack(ctx[0], ctx[1])
+
+
+def split_trace_header(body: memoryview | bytes
+                       ) -> tuple[tuple[int, int] | None, Any]:
+    """Strip a leading trace-context header off a received frame body:
+    returns ``((trace_id, span_id), rest)`` when present, ``(None,
+    body)`` otherwise — the caller dispatches on ``rest`` exactly as it
+    would have on an untraced body."""
+    if len(body) >= TRACE_HEADER_LEN and bytes(body[:1]) == b"t":
+        trace_id, span_id = _TRACE_HEADER.unpack(
+            bytes(body[1:TRACE_HEADER_LEN]))
+        return (trace_id, span_id), body[TRACE_HEADER_LEN:]
+    return None, body

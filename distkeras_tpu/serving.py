@@ -68,7 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu import telemetry
+from distkeras_tpu import flight_recorder, telemetry
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
 
@@ -418,6 +418,8 @@ class DecodeEngine:
                 and len(pool.queue) >= self.queue_bound):
             m.counter("serving_shed_total", reason="queue_full",
                       bucket=pool.env).inc()
+            flight_recorder.record("shed", reason="queue_full",
+                                   bucket=pool.env)
             raise ShedError(
                 "queue_full",
                 f"bucket {pool.env} admission queue at its bound "
@@ -587,6 +589,11 @@ class DecodeEngine:
         m.counter("serving_request_errors_total", bucket=env).inc()
         telemetry.instant("request_error", bucket=env,
                           request_id=req.rid, error=error)
+        # one durable event per terminal error result — covers
+        # deadline expiries, poisoned prefills, and engine_closed
+        # cancellations through the single exit point they share
+        flight_recorder.record("request_error", request_id=req.rid,
+                               bucket=env, error=error)
         ttft = (None if req.t_first is None
                 else req.t_first - req.t_submit)
         return {**req.meta,
@@ -692,7 +699,15 @@ class DecodeEngine:
             pool.cache = pool.state = None  # release the device pool
             self._note_gauges(pool)
         self._closed = True
+        flight_recorder.record("engine_closed", cancelled=len(out))
+        flight_recorder.flush()
         return out
+
+    def health(self) -> dict:
+        """SLO verdict over the active metrics registry — the same
+        evaluation ``/healthz`` serves (``ok``/``degraded``/
+        ``critical`` with per-signal breaches)."""
+        return telemetry.metrics().health()
 
     def __enter__(self) -> "DecodeEngine":
         return self

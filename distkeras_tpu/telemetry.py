@@ -76,12 +76,22 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 STALENESS_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128)
 
 
+def _escape_label_value(v: Any) -> str:
+    """Prometheus exposition escaping for label VALUES: backslash,
+    double-quote, and newline must be escaped or the emitted line is
+    invalid exposition text (a label value containing ``"`` would
+    terminate the value early; a newline would split the sample)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _label_key(name: str, labels: Mapping[str, Any]) -> str:
     """Prometheus-style series key: ``name{a="1",b="x"}`` (labels
-    sorted, values coerced to str)."""
+    sorted, values escaped per the exposition format)."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(f'{k}="{_escape_label_value(labels[k])}"'
+                     for k in sorted(labels))
     return f"{name}{{{inner}}}"
 
 
@@ -292,6 +302,7 @@ class MetricsRegistry:
         self._metrics: dict[str, tuple[str, str, dict, Any]] = {}
         self._httpd = None
         self._http_thread = None
+        self._watchdog: "SLOWatchdog | None" = None
 
     # -- get-or-create ------------------------------------------------
 
@@ -434,14 +445,32 @@ class MetricsRegistry:
                         f" {len(vals)}")
         return "\n".join(out) + "\n"
 
+    # -- health -------------------------------------------------------
+
+    def attach_watchdog(self, watchdog: "SLOWatchdog") -> None:
+        """Make ``watchdog`` the registry's health evaluator: its last
+        (or on-demand) evaluation backs ``health()`` and the
+        ``/healthz`` endpoint."""
+        self._watchdog = watchdog
+
+    def health(self) -> dict:
+        """The current SLO health verdict over this registry — the
+        attached watchdog's evaluation, or a one-shot default-threshold
+        ``SLOWatchdog`` pass when none is attached."""
+        w = self._watchdog
+        if w is None:
+            w = SLOWatchdog(self)
+        return w.evaluate()
+
     # -- the opt-in /metrics thread -----------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 0
               ) -> tuple[str, int]:
         """Start a background daemon thread serving ``GET /metrics``
-        (Prometheus text) and ``GET /metrics.json`` (the snapshot).
-        Returns the bound ``(host, port)``; ``port=0`` picks a free
-        one.  Call ``stop_serving()`` to shut it down."""
+        (Prometheus text), ``GET /metrics.json`` (the snapshot), and
+        ``GET /healthz`` (the SLO watchdog verdict; HTTP 503 when
+        critical).  Returns the bound ``(host, port)``; ``port=0``
+        picks a free one.  Call ``stop_serving()`` to shut it down."""
         if self._httpd is not None:
             return self._httpd.server_address[:2]
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -450,16 +479,23 @@ class MetricsRegistry:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                status = 200
                 if self.path.split("?")[0] == "/metrics":
                     body = registry.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.split("?")[0] == "/metrics.json":
                     body = json.dumps(registry.snapshot()).encode()
                     ctype = "application/json"
+                elif self.path.split("?")[0] == "/healthz":
+                    verdict = registry.health()
+                    body = json.dumps(verdict).encode()
+                    ctype = "application/json"
+                    if verdict["state"] == "critical":
+                        status = 503
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -468,7 +504,18 @@ class MetricsRegistry:
             def log_message(self, *args):  # scrapes are not stdout news
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        import errno
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            raise OSError(
+                e.errno,
+                f"metrics endpoint cannot bind {host}:{port}: the "
+                f"port is already in use — pass port=0 to let the OS "
+                f"pick a free one, or stop the other listener "
+                f"first") from e
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="dkt-metrics-http")
@@ -514,13 +561,56 @@ class NullRegistry:
     def prometheus_text(self) -> str:
         return ""
 
+    def health(self) -> dict:
+        # no signals → every threshold is "absent" → "ok"
+        return SLOWatchdog(self).evaluate()
+
+
+# -- trace context (cross-process propagation) -------------------------
+#
+# Every live ``_Span`` gets a PROCESS-UNIQUE 64-bit span id (the pid in
+# the high bits disambiguates ids minted by different processes, so a
+# merged multi-process trace never aliases two spans) and pushes
+# ``(trace_id, span_id)`` onto a thread-local stack.  A root span's id
+# doubles as the trace id; nested spans inherit the trace id, so a
+# retry storm inside one ``ps_op`` span shares one trace.  Wire clients
+# read ``current_trace()`` to stamp the 17-byte header the PS server
+# links back to (see ``parallel.transport.trace_header``).
+
+_span_id_lock = threading.Lock()
+_span_id_next = [1]
+_trace_ctx = threading.local()
+
+
+def _new_span_id() -> int:
+    with _span_id_lock:
+        n = _span_id_next[0]
+        _span_id_next[0] += 1
+    # 24 pid bits | 40 counter bits: unique within a process for 2^40
+    # spans, and across processes for merged traces
+    return ((os.getpid() & 0xFFFFFF) << 40) | (n & 0xFFFFFFFFFF)
+
+
+def current_trace() -> tuple[int, int] | None:
+    """``(trace_id, span_id)`` of this thread's innermost live span —
+    ``None`` when no span is open (always the case while telemetry is
+    disabled: only real spans push context)."""
+    stack = getattr(_trace_ctx, "stack", None)
+    if not stack:
+        return None
+    return stack[-1]
+
 
 class _Span:
     """One ``with``-scoped trace span: ts taken at enter, a Chrome
     complete ("X") event appended to the ring at exit.  Exceptions
-    inside the span mark ``args["error"]`` and re-raise."""
+    inside the span mark ``args["error"]`` and re-raise.  Enter pushes
+    ``(trace_id, span_id)`` onto the thread's trace-context stack (for
+    wire propagation); exit pops it and stamps both ids into the
+    event's args."""
 
-    __slots__ = ("_tracer", "name", "args", "_t0")
+    __slots__ = ("_tracer", "name", "args", "_t0", "trace_id",
+                 "span_id")
 
     def __init__(self, tracer: "Tracer", name: str, args: dict):
         self._tracer = tracer
@@ -528,14 +618,23 @@ class _Span:
         self.args = args
 
     def __enter__(self):
+        stack = getattr(_trace_ctx, "stack", None)
+        if stack is None:
+            stack = _trace_ctx.stack = []
+        sid = _new_span_id()
+        self.span_id = sid
+        self.trace_id = stack[-1][0] if stack else sid
+        stack.append((self.trace_id, sid))
         self._t0 = now()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         t1 = now()
-        args = self.args
+        _trace_ctx.stack.pop()
+        args = {**self.args, "trace_id": format(self.trace_id, "x"),
+                "span_id": format(self.span_id, "x")}
         if exc_type is not None:
-            args = {**args, "error": exc_type.__name__}
+            args["error"] = exc_type.__name__
         self._tracer._complete(self.name, self._t0, t1, args)
         return False
 
@@ -636,6 +735,26 @@ class Tracer:
             "name": name, "ph": "i", "ts": now() * 1e6, "s": "t",
             "pid": self._pid, "tid": tid, "args": args})
 
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        """Chrome flow-start ("s"): the tail of a client→server arrow.
+        ``flow_id`` must be process-unique (a span id); the matching
+        ``flow_end`` on the server side completes the arrow in the
+        merged trace."""
+        tid = self._note_thread()
+        self._ring.append({
+            "name": name, "cat": "wire", "ph": "s",
+            "id": format(flow_id, "x"), "ts": now() * 1e6,
+            "pid": self._pid, "tid": tid, "args": args})
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
+        """Chrome flow-finish ("f", binding point "e"): the head of the
+        arrow, emitted inside the server's handler span."""
+        tid = self._note_thread()
+        self._ring.append({
+            "name": name, "cat": "wire", "ph": "f", "bp": "e",
+            "id": format(flow_id, "x"), "ts": now() * 1e6,
+            "pid": self._pid, "tid": tid, "args": args})
+
     # -- export -------------------------------------------------------
 
     def events(self) -> list[dict]:
@@ -658,8 +777,13 @@ class Tracer:
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": self._pid, "tid": tid,
                          "args": {"name": tname}})
+        # wall↔mono anchor taken at DUMP time: ``merge_traces`` uses it
+        # to shift each process's arbitrary-origin perf_counter
+        # timestamps onto one shared timeline
         return {"traceEvents": meta + self.events(),
-                "displayTimeUnit": "ms"}
+                "displayTimeUnit": "ms",
+                "wallAnchor": {"wall_s": time.time(),
+                               "mono_s": now(), "pid": self._pid}}
 
     def write_chrome_trace(self, path: str | os.PathLike) -> str:
         p = os.fspath(path)
@@ -681,6 +805,12 @@ class NullTracer:
         pass
 
     def instant(self, name: str, **args) -> None:
+        pass
+
+    def flow_start(self, name: str, flow_id: int, **args) -> None:
+        pass
+
+    def flow_end(self, name: str, flow_id: int, **args) -> None:
         pass
 
     def events(self) -> list:
@@ -766,6 +896,67 @@ def complete(name: str, t0: float, **args) -> None:
     _active.tracer.complete(name, t0, now(), **args)
 
 
+def flow_start(name: str, flow_id: int, **args) -> None:
+    _active.tracer.flow_start(name, flow_id, **args)
+
+
+def flow_end(name: str, flow_id: int, **args) -> None:
+    _active.tracer.flow_end(name, flow_id, **args)
+
+
+def merge_traces(*traces: Mapping | list) -> dict:
+    """Stitch per-process Chrome trace dumps into ONE timeline.
+
+    Each argument is a ``chrome_trace()``-shaped dict (or a bare event
+    list).  Two alignments happen:
+
+    * **Clock**: ``perf_counter`` origins are arbitrary per process, so
+      each trace's ``wallAnchor`` (wall + mono stamp taken at dump
+      time) shifts its timestamps onto the FIRST anchored trace's
+      timeline.  Traces without an anchor pass through unshifted.
+    * **Pid collision**: two dumps claiming one pid (e.g. a tracer
+      dumped twice, or pid reuse across hosts) get the later dump
+      remapped to a fresh synthetic pid so Perfetto renders them as
+      distinct process tracks.
+
+    Flow events ("s"/"f") survive untouched — their ids were minted
+    process-unique — so client→server arrows span process boundaries
+    in the merged view."""
+    merged: list[dict] = []
+    used_pids: set[int] = set()
+    base_offset: float | None = None  # wall_s - mono_s of first anchor
+    for t in traces:
+        if isinstance(t, Mapping):
+            events = list(t.get("traceEvents", []))
+            anchor = t.get("wallAnchor")
+        else:
+            events, anchor = list(t), None
+        shift_us = 0.0
+        if anchor is not None:
+            offset = float(anchor["wall_s"]) - float(anchor["mono_s"])
+            if base_offset is None:
+                base_offset = offset
+            shift_us = (offset - base_offset) * 1e6
+        pids = sorted({e["pid"] for e in events if "pid" in e})
+        remap: dict[int, int] = {}
+        for p in pids:
+            q = p
+            while q in used_pids:
+                q += 1_000_000  # synthetic pid for the colliding dump
+            remap[p] = q
+            used_pids.add(q)
+        for e in events:
+            if shift_us and "ts" in e:
+                e = {**e, "ts": e["ts"] + shift_us}
+            p = e.get("pid")
+            if p is not None and remap.get(p) != p:
+                e = {**e, "pid": remap[p]}
+            merged.append(e)
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0.0)))
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
 def enable(ring_capacity: int = 65536,
            telemetry: Telemetry | None = None) -> Telemetry:
     """Install (and return) the global ``Telemetry``.  Idempotent-ish:
@@ -791,6 +982,171 @@ def disable() -> None:
         old, _active = _active, _NULL
     if isinstance(getattr(old, "metrics", None), MetricsRegistry):
         old.metrics.stop_serving()
+
+
+# -- SLO watchdog ------------------------------------------------------
+
+#: ``signal -> (degraded_at, critical_at)`` — inclusive lower bounds;
+#: a signal at/above ``degraded_at`` degrades the verdict, at/above
+#: ``critical_at`` makes it critical.  Signals with no samples in the
+#: registry are skipped (absence of traffic is not an outage).
+DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
+    "staleness_p99": (16.0, 64.0),        # commits of center drift
+    "retry_rate": (0.5, 2.0),             # client retries per commit
+    "shed_rate": (0.05, 0.25),            # sheds per submitted request
+    "queue_depth": (64.0, 256.0),         # queued requests, all buckets
+    "ttft_p95_s": (1.0, 10.0),            # seconds to first token
+    "idle_worker_fraction": (0.34, 0.75),  # silent / registered
+}
+
+
+def _merged_percentile(registry, name: str, q: float) -> float | None:
+    """Bucket-resolution percentile over EVERY histogram instance named
+    ``name`` (all label sets merged); None when there are no samples.
+    Instances of one name share bucket edges by construction."""
+    snaps = [m.snapshot() for _, m in registry.collect(name)]
+    snaps = [s for s in snaps if s.get("count")]
+    if not snaps:
+        return None
+    total = sum(s["count"] for s in snaps)
+    need = q * total
+    for edge in sorted(snaps[0]["buckets"]):
+        if sum(s["buckets"].get(edge, 0) for s in snaps) >= need:
+            return float(edge)
+    return float(max(s["max"] for s in snaps))
+
+
+class SLOWatchdog:
+    """Declarative health evaluator over a ``MetricsRegistry``.
+
+    Six signals (PS staleness p99, client retry rate, serving shed
+    rate, queue depth, TTFT p95, idle-worker fraction) are computed
+    from the registry's live metrics and compared against
+    ``(degraded_at, critical_at)`` thresholds; the worst breach decides
+    the ``ok`` / ``degraded`` / ``critical`` state.  ``evaluate()`` is
+    a cheap one-shot pass (the ``/healthz`` endpoint calls it per
+    request); ``start()`` adds a background thread that re-evaluates
+    every ``interval_s`` and drops an ``slo_state`` instant on the
+    trace (plus a flight-recorder event) whenever the state changes.
+    """
+
+    def __init__(self, registry,
+                 thresholds: Mapping[str, tuple] | None = None,
+                 interval_s: float = 1.0):
+        self.registry = registry
+        self.thresholds = dict(DEFAULT_SLO_THRESHOLDS)
+        if thresholds:
+            for k, pair in thresholds.items():
+                if k not in DEFAULT_SLO_THRESHOLDS:
+                    raise ValueError(
+                        f"unknown SLO signal {k!r}; expected one of "
+                        f"{sorted(DEFAULT_SLO_THRESHOLDS)}")
+                d, c = float(pair[0]), float(pair[1])
+                if d > c:
+                    raise ValueError(
+                        f"SLO signal {k!r}: degraded_at ({d}) must "
+                        f"not exceed critical_at ({c})")
+                self.thresholds[k] = (d, c)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._last: dict = {"state": "ok", "signals": {},
+                            "breaches": {}}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- signal extraction --------------------------------------------
+
+    def signals(self) -> dict[str, float]:
+        """The subset of the six signals the registry has samples for."""
+        r = self.registry
+        out: dict[str, float] = {}
+        p99 = _merged_percentile(r, "ps_commit_staleness", 0.99)
+        if p99 is not None:
+            out["staleness_p99"] = p99
+        commits = r.sum_counter("ps_commits_total")
+        retries = r.sum_counter("ps_client_retries_total")
+        if commits or retries:
+            out["retry_rate"] = retries / max(commits, 1.0)
+        reqs = r.sum_counter("serving_requests_total")
+        sheds = r.sum_counter("serving_shed_total")
+        if reqs or sheds:
+            out["shed_rate"] = sheds / max(reqs, 1.0)
+        depth = r.collect("serving_queue_depth")
+        if depth:
+            out["queue_depth"] = float(sum(m.value for _, m in depth))
+        p95 = _merged_percentile(r, "serving_ttft_seconds", 0.95)
+        if p95 is not None:
+            out["ttft_p95_s"] = p95
+        registered = sum(m.value for _, m
+                         in r.collect("ps_registered_workers"))
+        if registered > 0:
+            idle = sum(m.value for _, m in r.collect("ps_idle_workers"))
+            out["idle_worker_fraction"] = idle / registered
+        return out
+
+    # -- evaluation ---------------------------------------------------
+
+    def evaluate(self) -> dict:
+        sig = self.signals()
+        rank = {"ok": 0, "degraded": 1, "critical": 2}
+        state, breaches = "ok", {}
+        for k, v in sig.items():
+            degraded_at, critical_at = self.thresholds[k]
+            level = ("critical" if v >= critical_at else
+                     "degraded" if v >= degraded_at else "ok")
+            if level != "ok":
+                breaches[k] = {"value": v, "level": level,
+                               "degraded_at": degraded_at,
+                               "critical_at": critical_at}
+            if rank[level] > rank[state]:
+                state = level
+        verdict = {"state": state, "signals": sig,
+                   "breaches": breaches}
+        with self._lock:
+            prev = self._last["state"]
+            self._last = verdict
+        if prev != state:
+            instant("slo_state", state=state,
+                    breaches=sorted(breaches))
+            from distkeras_tpu import flight_recorder
+            flight_recorder.record("slo_state", state=state,
+                                   previous=prev,
+                                   breaches=sorted(breaches))
+        return verdict
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._last["state"]
+
+    def last(self) -> dict:
+        """The most recent verdict (without re-evaluating)."""
+        with self._lock:
+            return dict(self._last)
+
+    # -- background loop ----------------------------------------------
+
+    def start(self) -> "SLOWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.evaluate()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="dkt-slo-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        """Stop the background loop; returns one final evaluation."""
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
+        return self.evaluate()
 
 
 class HistoryView(collections.abc.Mapping):

@@ -2061,6 +2061,9 @@ class DistributedTrainer(Trainer):
             self._record(
                 pull_shards_skipped=int(skip_total.value),
                 pull_bytes_saved=int(saved_total.value))
+        # end-of-run SLO verdict over whatever the run metered (with
+        # telemetry disabled every signal is absent → "ok")
+        self._record(slo_health=telemetry.metrics().health()["state"])
 
         # round_loss is per-process telemetry (this process's workers);
         # epoch_loss / dropped tails are reduced globally so every

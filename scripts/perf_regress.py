@@ -1,0 +1,234 @@
+"""Perf regression sentinel — compare candidate metrics against the
+recorded BENCH_*.json trajectory baselines and exit nonzero on breach
+(ISSUE 6 tentpole 4: the CI perf gate).
+
+Baselines are the repo's benchmark trajectory files (``BENCH_r01.json``
+..., each ``{"n", "cmd", "rc", "tail", "parsed": {"metric", "value",
+"unit", ...}}``).  The gate takes the MEDIAN of each metric's
+trajectory as its reference (one noisy run neither tightens nor
+loosens the gate) and flags a candidate below ``(1 - tolerance) *
+reference`` (``--lower-is-better`` flips the direction for latency-
+style metrics).  A baseline run that itself failed (``rc != 0``) is
+excluded from the trajectory.
+
+Candidates come from either:
+
+* ``--candidate FILE`` — a JSON file holding one parsed-format record
+  (``{"metric": ..., "value": ...}``) or a list of them, e.g. the
+  ``parsed`` block a fresh ``bench.py`` run printed;
+* ``--from-registry SNAP --metric NAME --counter C --seconds S`` — a
+  ``MetricsRegistry.snapshot()`` JSON from a smoke run, synthesizing
+  ``NAME = sum(counter C) / S`` (a rate), so a CPU smoke can gate on
+  its own throughput without a device benchmark.
+
+``--smoke`` is the self-contained tier-1 proof: it runs a tiny
+socket-transport training, derives a commits/sec candidate from the
+live registry, gates it against a synthetic trajectory written from
+the same run (pass), then gates a 10x-degraded candidate (must
+breach) — both directions of the sentinel exercised end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import pathlib
+import statistics
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINES = str(REPO / "BENCH_*.json")
+
+
+# ---- the gate ----------------------------------------------------------
+
+def load_trajectories(pattern: str) -> dict[str, list[float]]:
+    """metric name -> trajectory of values, oldest first, failed runs
+    (rc != 0) excluded."""
+    out: dict[str, list[float]] = {}
+    records = []
+    for path in glob.glob(pattern):
+        try:
+            rec = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = rec.get("parsed")
+        if not parsed or rec.get("rc", 0) != 0:
+            continue
+        records.append((rec.get("n", 0), parsed))
+    for _, parsed in sorted(records, key=lambda r: r[0]):
+        out.setdefault(parsed["metric"], []).append(
+            float(parsed["value"]))
+    return out
+
+
+def evaluate(candidates: list[dict],
+             trajectories: dict[str, list[float]],
+             tolerance: float = 0.15,
+             lower_is_better: bool = False) -> list[dict]:
+    """One verdict row per candidate metric: reference (trajectory
+    median), bound, pass/breach/no-baseline."""
+    rows = []
+    for cand in candidates:
+        name, value = cand["metric"], float(cand["value"])
+        traj = trajectories.get(name)
+        if not traj:
+            rows.append({"metric": name, "value": value,
+                         "status": "no-baseline"})
+            continue
+        ref = statistics.median(traj)
+        if lower_is_better:
+            bound = ref * (1.0 + tolerance)
+            ok = value <= bound
+        else:
+            bound = ref * (1.0 - tolerance)
+            ok = value >= bound
+        rows.append({"metric": name, "value": value, "ref": ref,
+                     "bound": bound, "trajectory": traj,
+                     "status": "pass" if ok else "breach"})
+    return rows
+
+
+def render(rows: list[dict]) -> str:
+    lines = ["perf regression gate"]
+    for r in rows:
+        if r["status"] == "no-baseline":
+            lines.append(f"  {r['metric']:<44} value={r['value']:g} "
+                         "— no baseline trajectory, skipped")
+            continue
+        lines.append(
+            f"  {r['metric']:<44} value={r['value']:g} "
+            f"ref(median of {len(r['trajectory'])})={r['ref']:g} "
+            f"bound={r['bound']:g} -> {r['status'].upper()}")
+    return "\n".join(lines)
+
+
+def from_registry(snapshot_path: str, metric: str, counter: str,
+                  seconds: float) -> list[dict]:
+    """Synthesize a rate candidate from a registry-snapshot JSON: the
+    sum of every labeled series of ``counter``, divided by the run's
+    wall seconds."""
+    snap = json.load(open(snapshot_path))
+    total = sum(v for key, v in snap.get("counters", {}).items()
+                if key == counter or key.startswith(counter + "{"))
+    return [{"metric": metric, "value": total / seconds,
+             "unit": "per_sec"}]
+
+
+# ---- the smoke run -----------------------------------------------------
+
+def smoke(out_dir: str) -> None:
+    import time
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import model_config
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    tel = telemetry.enable()
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    data = datasets.synthetic_classification(512, (8,), 4, seed=0)
+    t0 = time.perf_counter()
+    DOWNPOUR(mlp, fidelity="host", transport="socket", num_workers=2,
+             communication_window=2, batch_size=16, num_epoch=1,
+             learning_rate=0.01, worker_optimizer="adam").train(data)
+    seconds = time.perf_counter() - t0
+    snap_path = out / "registry.json"
+    snap_path.write_text(json.dumps(tel.metrics.snapshot(),
+                                    default=repr))
+    telemetry.disable()
+
+    cands = from_registry(str(snap_path), "smoke_ps_commits_per_sec",
+                          "ps_commits_total", seconds)
+    assert cands[0]["value"] > 0, cands
+
+    # synthetic trajectory from this very run: the gate's reference
+    for n in (1, 2, 3):
+        (out / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+            "parsed": {"metric": "smoke_ps_commits_per_sec",
+                       "value": cands[0]["value"] * (1 + 0.02 * n),
+                       "unit": "per_sec"}}))
+    traj = load_trajectories(str(out / "BENCH_*.json"))
+
+    rows = evaluate(cands, traj, tolerance=0.5)
+    print(render(rows))
+    assert all(r["status"] == "pass" for r in rows), rows
+
+    degraded = [{"metric": cands[0]["metric"],
+                 "value": cands[0]["value"] / 10.0}]
+    bad = evaluate(degraded, traj, tolerance=0.5)
+    print(render(bad))
+    assert bad[0]["status"] == "breach", bad
+
+    unknown = evaluate([{"metric": "no_such_metric", "value": 1.0}],
+                       traj)
+    assert unknown[0]["status"] == "no-baseline", unknown
+    print("smoke: ok")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baselines", default=DEFAULT_BASELINES,
+                    help="baseline trajectory glob "
+                         "(default: repo BENCH_*.json)")
+    ap.add_argument("--candidate", default=None,
+                    help="candidate JSON: one parsed-format record or "
+                         "a list of them")
+    ap.add_argument("--from-registry", default=None, metavar="SNAP",
+                    help="MetricsRegistry.snapshot() JSON to derive a "
+                         "rate candidate from")
+    ap.add_argument("--metric", default=None,
+                    help="--from-registry: candidate metric name")
+    ap.add_argument("--counter", default=None,
+                    help="--from-registry: counter to rate")
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="--from-registry: run wall seconds")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed fractional slack vs the trajectory "
+                         "median")
+    ap.add_argument("--lower-is-better", action="store_true",
+                    help="breach when the candidate EXCEEDS the bound "
+                         "(latency-style metrics)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-contained gate proof (tier-1 mode)")
+    ap.add_argument("--out-dir", default=None,
+                    help="--smoke artifact directory (temp default)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        smoke(args.out_dir or tempfile.mkdtemp(prefix="dkt_gate_"))
+        return
+
+    if args.candidate:
+        loaded = json.load(open(args.candidate))
+        candidates = loaded if isinstance(loaded, list) else [loaded]
+        if all("parsed" in c for c in candidates):
+            candidates = [c["parsed"] for c in candidates]
+    elif args.from_registry:
+        if not (args.metric and args.counter and args.seconds):
+            ap.error("--from-registry needs --metric, --counter and "
+                     "--seconds")
+        candidates = from_registry(args.from_registry, args.metric,
+                                   args.counter, args.seconds)
+    else:
+        ap.error("pass --candidate or --from-registry (or --smoke)")
+
+    rows = evaluate(candidates, load_trajectories(args.baselines),
+                    tolerance=args.tolerance,
+                    lower_is_better=args.lower_is_better)
+    print(render(rows))
+    if any(r["status"] == "breach" for r in rows):
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""Postmortem — reconstruct the last N seconds before a crash from the
+flight recorder's on-disk ring and cross-check it against durable
+state (ISSUE 6 tentpole 2).
+
+The in-memory trace ring dies with its process; the flight recorder
+(``distkeras_tpu.flight_recorder``) survives it.  This script replays
+the surviving JSONL window ending at the crash marker (the last
+``ps_kill`` event, or the newest event when no kill was recorded),
+prints a per-kind timeline, derives the last ACKED commit seq per
+worker from the recorded ``commit`` events, and — given the PS
+snapshot the dead server was writing — cross-checks that against the
+snapshot's dedupe table (``checkpoint.ps_snapshot_info``'s
+``last_acked``): a mismatch means commits were applied after the last
+durable snapshot (data at risk), agreement proves the restart resumes
+exactly where the flight recorder says the crash happened.
+
+Modes:
+
+* ``--flight DIR [--seconds 30] [--snapshot ps.snap]`` — report on an
+  existing recorder directory.
+* ``--smoke`` — self-contained crash proof (the tier-1 registration):
+  records a real host-PS run with ``snapshot_every=1``, ``kill()``s
+  the server mid-stream, warm-restarts it from the snapshot, and
+  asserts the postmortem's last-acked seqs match the restarted
+  server's dedupe state exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+# ---- reconstruction ----------------------------------------------------
+
+def reconstruct(flight_dir: str, seconds: float = 30.0,
+                snapshot: str | None = None) -> dict:
+    """The postmortem: crash marker, event window, per-worker
+    last-acked seqs, and (with a snapshot) the durable cross-check."""
+    from distkeras_tpu.checkpoint import ps_snapshot_info
+    from distkeras_tpu.flight_recorder import FlightRecorder
+
+    events = FlightRecorder(flight_dir).read_events()
+    if not events:
+        raise SystemExit(f"no flight events under {flight_dir}")
+    kills = [e for e in events if e["kind"] == "ps_kill"]
+    crash = kills[-1] if kills else events[-1]
+    window = [e for e in events
+              if crash["wall_s"] - seconds <= e["wall_s"]
+              <= crash["wall_s"]]
+    # ACKED means APPLIED-and-replied or deduped-and-replied: both
+    # kinds prove the worker's seq reached the dedupe table
+    acked: dict[str, int] = {}
+    for e in window:
+        if e["kind"] in ("commit", "commit_dedup"):
+            w, seq = str(e["worker"]), int(e["seq"])
+            acked[w] = max(acked.get(w, seq), seq)
+    report = {
+        "crash": crash,
+        "window_s": seconds,
+        "events": window,
+        "kinds": dict(collections.Counter(e["kind"] for e in window)),
+        "flight_last_acked": acked,
+    }
+    if snapshot is not None:
+        info = ps_snapshot_info(snapshot)
+        report["snapshot"] = info
+        report["acked_match"] = (
+            {w: int(s) for w, s in info["last_acked"].items()}
+            == {w: int(s) for w, s in acked.items()})
+    return report
+
+
+def render(report: dict) -> str:
+    crash = report["crash"]
+    lines = [
+        "distkeras_tpu postmortem",
+        f"crash marker: {crash['kind']} at wall "
+        f"{crash['wall_s']:.3f} (pid {crash['pid']})",
+        f"window: last {report['window_s']:g}s — "
+        f"{len(report['events'])} events",
+    ]
+    for kind, n in sorted(report["kinds"].items(),
+                          key=lambda kv: -kv[1]):
+        lines.append(f"  {kind:<16} n={n}")
+    lines.append("last acked commit seq per worker (flight): "
+                 + json.dumps(report["flight_last_acked"],
+                              sort_keys=True))
+    if "snapshot" in report:
+        info = report["snapshot"]
+        lines.append(
+            f"snapshot: commits={info['num_commits']} "
+            f"last_acked={json.dumps(info['last_acked'], sort_keys=True)}")
+        lines.append("cross-check: "
+                     + ("MATCH — restart resumes exactly at the "
+                        "recorded crash point"
+                        if report["acked_match"] else
+                        "MISMATCH — commits applied after the last "
+                        "durable snapshot"))
+    tail = report["events"][-8:]
+    lines.append(f"final {len(tail)} events before the crash:")
+    for e in tail:
+        detail = {k: v for k, v in e.items()
+                  if k not in ("kind", "wall_s", "mono_s", "pid",
+                               "rec_seq")}
+        lines.append(f"  +{e['wall_s'] - crash['wall_s']:+.3f}s "
+                     f"{e['kind']:<14} {json.dumps(detail)}")
+    return "\n".join(lines)
+
+
+# ---- the smoke run -----------------------------------------------------
+
+def smoke(out_dir: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu import flight_recorder
+    from distkeras_tpu.models import ModelSpec, model_config
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSClient, PSServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    out = pathlib.Path(out_dir)
+    flight_dir = out / "flight"
+    snap = out / "ps.snap"
+    flight_recorder.start(flight_dir)
+
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    model = ModelSpec.from_config(mlp).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+
+    ps = HostParameterServer(DownpourRule(), center,
+                             snapshot_path=snap, snapshot_every=1)
+    srv = PSServer(ps, center).start()
+    client = PSClient("127.0.0.1", srv.address[1], 0, center)
+    client.pull()
+    delta = jax.tree_util.tree_map(
+        lambda x: np.full_like(x, 0.01), center)
+    for seq in range(6):
+        client.commit(delta, seq=seq)
+    client.commit(delta, seq=5)  # lost-ack retry: deduped, recorded
+
+    srv.kill()  # crash: flight ring fsynced, sockets die
+    client.close()
+
+    srv2 = PSServer.restart_from(snap, DownpourRule(), center)
+    try:
+        restarted = {str(w): int(s)
+                     for w, s in srv2.ps.last_acked_seqs().items()}
+    finally:
+        srv2.stop()
+    flight_recorder.stop()
+
+    report = reconstruct(str(flight_dir), seconds=30.0,
+                         snapshot=str(snap))
+    print(render(report))
+
+    # THE acceptance cross-check: the flight recorder's last-acked
+    # seqs == the snapshot's dedupe table == the restarted server's
+    assert report["acked_match"], report
+    assert report["flight_last_acked"] == restarted, (
+        report["flight_last_acked"], restarted)
+    assert report["crash"]["kind"] == "ps_kill"
+    assert report["kinds"].get("commit") == 6
+    assert report["kinds"].get("commit_dedup") == 1
+    assert report["kinds"].get("snapshot", 0) >= 6
+    print("smoke: ok")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--flight", default=None,
+                    help="flight-recorder directory to reconstruct")
+    ap.add_argument("--seconds", type=float, default=30.0,
+                    help="window width before the crash marker")
+    ap.add_argument("--snapshot", default=None,
+                    help="PS snapshot to cross-check against")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-contained kill/restart proof "
+                         "(tier-1 mode)")
+    ap.add_argument("--out-dir", default=None,
+                    help="--smoke artifact directory (temp default)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        smoke(args.out_dir or tempfile.mkdtemp(prefix="dkt_pm_"))
+        return
+    if not args.flight:
+        ap.error("pass --flight DIR (or --smoke)")
+    print(render(reconstruct(args.flight, seconds=args.seconds,
+                             snapshot=args.snapshot)))
+
+
+if __name__ == "__main__":
+    main()

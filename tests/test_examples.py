@@ -27,8 +27,11 @@ SMOKE_SCRIPTS = {
     "chaos_report.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
+    "perf_regress.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],
+    "postmortem.py": ["--smoke"],
+    "trace_merge.py": ["--smoke"],
 }
 # registered but out of tier-1: the roofline smoke sweeps many op
 # shapes and runs minutes-long on the CI CPU (run with -m slow)

@@ -191,3 +191,61 @@ def test_chaos_sweep_against_sharded_server(fault):
     # every shard saw every logical commit exactly once
     assert [s.num_commits for s in ps._shards] == \
         [ps.num_commits] * ps.num_shards
+
+
+def test_uninstall_is_idempotent_and_stack_safe():
+    """ISSUE 6 satellite: ``uninstall`` twice is a no-op (nested
+    harnesses' finally paths may both fire), never-installed instances
+    uninstall harmlessly, and a stale instance whose wrappers were
+    already replaced by a LATER injector restores NOTHING — only a
+    LIFO unstack walks the bindings back to the true originals."""
+    orig = (transport.connect, transport.send_msg, transport.recv_msg,
+            transport.send_msg_gather, transport.recv_msg_into)
+
+    def bindings():
+        return (transport.connect, transport.send_msg,
+                transport.recv_msg, transport.send_msg_gather,
+                transport.recv_msg_into)
+
+    # double uninstall: the second call is a no-op, not a clobber
+    a = ChaosTransport(seed=0)
+    a.install()
+    a.uninstall()
+    a.uninstall()
+    assert bindings() == orig
+    # uninstall without install is equally harmless
+    ChaosTransport(seed=1).uninstall()
+    assert bindings() == orig
+
+    # a full reinstall cycle still works after the double-uninstall
+    with ChaosTransport(seed=2) as c:
+        assert transport.send_msg.__self__ is c
+    assert bindings() == orig
+
+    # LIFO stack: B on top of A; unstacking in reverse order restores
+    # first A's wrappers, then the originals
+    a, b = ChaosTransport(seed=3), ChaosTransport(seed=4)
+    a.install()
+    b.install()
+    assert transport.send_msg.__self__ is b
+    b.uninstall()
+    assert transport.send_msg.__self__ is a
+    a.uninstall()
+    assert bindings() == orig
+
+    # OUT-OF-ORDER unstack: A.uninstall while B is stacked on top must
+    # not clobber B's live wrappers with A's stale snapshot
+    a, b = ChaosTransport(seed=5), ChaosTransport(seed=6)
+    a.install()
+    b.install()
+    a.uninstall()
+    assert transport.send_msg.__self__ is b, (
+        "stale uninstall clobbered the newer injector's bindings")
+    b.uninstall()
+    # B's snapshot was A's wrappers; A is already spent, so walk the
+    # bindings home by hand (A keeps _orig for still-blocked threads,
+    # and its wrappers delegate to the originals meanwhile)
+    assert transport.send_msg.__self__ is a
+    a._installed = True
+    a.uninstall()
+    assert bindings() == orig

@@ -121,7 +121,7 @@ def test_every_emitted_history_key_is_documented():
             "skipped_segment_rows", "eval_accuracy", "member_loss",
             "worker_failures", "worker_round_retries",
             "commit_wire_bytes", "commit_raw_bytes", "ps_snapshots",
-            "pull_shards_skipped", "pull_bytes_saved"}
+            "pull_shards_skipped", "pull_bytes_saved", "slo_health"}
     missing = core - emitted
     assert not missing, (
         f"collection no longer exercises core history keys: "

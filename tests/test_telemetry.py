@@ -173,16 +173,23 @@ def test_disabled_fast_path_is_inert():
 def check_perfetto_valid(trace: dict) -> None:
     """The validity contract: required ``ph``/``ts``/``pid``/``tid``
     fields on every timed event, non-negative durations, per-thread
-    monotone completion timestamps (events append at span exit), and a
-    thread-name metadata record per thread track."""
+    monotone completion timestamps (events append at span exit), a
+    thread-name metadata record per thread track, and flow-event
+    pairing — every flow-end ("f") matches exactly ONE flow-start
+    ("s") by (name, cat, id).  Orphan starts are legal: a chaos-eaten
+    message has a sender but never reaches a handler."""
+    import collections
+
     events = trace["traceEvents"]
     assert events, "empty trace"
     named_tids = {e["tid"] for e in events
                   if e.get("ph") == "M"
                   and e.get("name") == "thread_name"}
     ends: dict[int, float] = {}
+    flow_starts: collections.Counter = collections.Counter()
+    flow_ends = []
     for e in events:
-        assert e.get("ph") in ("X", "i", "M"), e
+        assert e.get("ph") in ("X", "i", "M", "s", "f"), e
         assert isinstance(e.get("pid"), int)
         assert isinstance(e.get("tid"), int)
         if e["ph"] == "M":
@@ -194,6 +201,18 @@ def check_perfetto_valid(trace: dict) -> None:
             end = e["ts"] + e["dur"]
             assert end >= ends.get(e["tid"], 0.0)
             ends[e["tid"]] = end
+        elif e["ph"] in ("s", "f"):
+            assert isinstance(e.get("id"), str) and e.get("cat"), e
+            key = (e["name"], e["cat"], e["id"])
+            if e["ph"] == "s":
+                flow_starts[key] += 1
+            else:
+                assert e.get("bp") == "e", e
+                flow_ends.append(key)
+    for key in flow_ends:
+        assert flow_starts.get(key, 0) == 1, (
+            f"flow-end {key} has {flow_starts.get(key, 0)} matching "
+            f"starts (want exactly 1)")
     json.loads(json.dumps(trace))  # serializable as-is
 
 
@@ -368,3 +387,371 @@ def test_engine_timing_fields_without_telemetry_enabled():
     assert r["t_submit"] <= r["t_first"] <= r["t_finish"]
     assert r["ttft"] == pytest.approx(r["t_first"] - r["t_submit"])
     assert r["latency"] == pytest.approx(r["t_finish"] - r["t_submit"])
+
+
+# ---- label escaping / bound port / healthz (ISSUE 6 satellites) -------
+
+def test_prometheus_label_value_escaping():
+    """Hostile label values (quotes, backslashes, newlines) must not
+    corrupt the exposition format — and plain values must render
+    byte-identically to before."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("reqs_total", bucket=16).inc(3)
+    reg.counter("errs_total", path='say "hi"\\n').inc()
+    reg.counter("errs_total", path="a\nb").inc(2)
+    txt = reg.prometheus_text()
+    assert 'reqs_total{bucket="16"} 3' in txt  # plain path unchanged
+    assert 'errs_total{path="say \\"hi\\"\\\\n"} 1' in txt
+    assert 'errs_total{path="a\\nb"} 2' in txt
+    # one line per sample: the raw newline never split a line
+    for line in txt.splitlines():
+        if line and not line.startswith("#"):
+            assert line.rsplit(" ", 1)[1].replace(".", "").isdigit()
+
+
+def test_serve_bound_port_error_names_port():
+    reg = telemetry.MetricsRegistry()
+    host, port = reg.serve(port=0)
+    other = telemetry.MetricsRegistry()
+    try:
+        with pytest.raises(OSError, match=f"{port}.*already in use"):
+            other.serve(host=host, port=port)
+        # ...and the recovery path the message recommends works
+        h2, p2 = other.serve(port=0)
+        assert p2 != port
+    finally:
+        other.stop_serving()
+        reg.stop_serving()
+
+
+def _read(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_endpoint_reports_slo_state():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("serving_requests_total", bucket=16).inc(100)
+    host, port = reg.serve(port=0)
+    try:
+        status, verdict = _read(f"http://{host}:{port}/healthz")
+        assert status == 200 and verdict["state"] == "ok"
+        # 30% sheds >= the 25% critical threshold -> HTTP 503
+        reg.counter("serving_shed_total", reason="queue_full",
+                    bucket=16).inc(30)
+        status, verdict = _read(f"http://{host}:{port}/healthz")
+        assert status == 503 and verdict["state"] == "critical"
+        assert verdict["breaches"]["shed_rate"]["level"] == "critical"
+    finally:
+        reg.stop_serving()
+
+
+# ---- SLO watchdog ------------------------------------------------------
+
+def test_slo_watchdog_thresholds_and_transitions(tel):
+    reg = telemetry.MetricsRegistry()
+    with pytest.raises(ValueError, match="unknown SLO signal"):
+        telemetry.SLOWatchdog(reg, thresholds={"nope": (1, 2)})
+    with pytest.raises(ValueError, match="must not exceed"):
+        telemetry.SLOWatchdog(reg,
+                              thresholds={"retry_rate": (2.0, 1.0)})
+
+    w = telemetry.SLOWatchdog(reg)
+    assert w.evaluate() == {"state": "ok", "signals": {},
+                            "breaches": {}}  # no traffic != outage
+    h = reg.histogram("ps_commit_staleness",
+                      buckets=telemetry.STALENESS_BUCKETS)
+    for _ in range(100):
+        h.observe(20)  # p99 = 20 >= degraded_at 16, < critical 64
+    v = w.evaluate()
+    assert v["state"] == "degraded"
+    assert v["breaches"]["staleness_p99"]["level"] == "degraded"
+    for _ in range(900):
+        h.observe(100)
+    v = w.evaluate()
+    assert v["state"] == "critical" and w.state == "critical"
+    # state CHANGES drop slo_state instants on the trace (2 flips)
+    flips = [e for e in tel.tracer.events()
+             if e["name"] == "slo_state"]
+    assert [e["args"]["state"] for e in flips] == ["degraded",
+                                                  "critical"]
+    assert w.last() == v
+
+    # idle fraction needs the registered-workers denominator
+    reg2 = telemetry.MetricsRegistry()
+    reg2.gauge("ps_registered_workers").set(4)
+    reg2.gauge("ps_idle_workers").set(3)
+    v2 = telemetry.SLOWatchdog(reg2).evaluate()
+    assert v2["signals"]["idle_worker_fraction"] == 0.75
+    assert v2["state"] == "critical"
+
+    # background loop + attach: registry.health() uses the attached
+    # watchdog (custom thresholds visible through /healthz's path)
+    w3 = telemetry.SLOWatchdog(reg2, thresholds={
+        "idle_worker_fraction": (0.9, 0.95)}, interval_s=0.01)
+    reg2.attach_watchdog(w3)
+    assert reg2.health()["state"] == "ok"
+    w3.start()
+    assert w3.start() is w3  # idempotent
+    final = w3.stop()
+    assert final["state"] == "ok"
+
+
+# ---- trace context + wire header --------------------------------------
+
+def test_trace_context_nesting_and_wire_header(tel):
+    from distkeras_tpu.parallel import transport
+
+    assert telemetry.current_trace() is None
+    assert transport.trace_header() == b""  # tracing off: ZERO bytes
+    with telemetry.span("root") as root:
+        trace_id, span_id = telemetry.current_trace()
+        assert trace_id == span_id == root.span_id  # root id IS trace
+        with telemetry.span("child") as child:
+            t2, s2 = telemetry.current_trace()
+            assert t2 == trace_id and s2 == child.span_id != span_id
+            hdr = transport.trace_header()
+            assert len(hdr) == transport.TRACE_HEADER_LEN == 17
+            link, rest = transport.split_trace_header(
+                hdr + b"c" + b"payload")
+            assert link == (t2, s2) and bytes(rest) == b"cpayload"
+        assert telemetry.current_trace() == (trace_id, span_id)
+    assert telemetry.current_trace() is None
+    # an untraced body passes through unmodified
+    link, rest = transport.split_trace_header(b"p")
+    assert link is None and rest == b"p"
+    # span ids are process-unique and stamped into exported args
+    evs = {e["name"]: e for e in tel.tracer.events()}
+    assert evs["child"]["args"]["trace_id"] == \
+        evs["root"]["args"]["span_id"]
+    assert evs["child"]["args"]["span_id"] != \
+        evs["root"]["args"]["span_id"]
+
+
+def test_merge_traces_clock_shift_and_pid_collision():
+    def tr(pid, wall, mono, ts):
+        return {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"p{pid}"}},
+            {"name": "s1", "ph": "X", "ts": ts, "dur": 5.0,
+             "pid": pid, "tid": 1, "args": {}}],
+            "wallAnchor": {"wall_s": wall, "mono_s": mono,
+                           "pid": pid}}
+
+    # same wall instant, different perf_counter origins: process B's
+    # mono clock reads 2s lower, so its events shift +2s in the merge
+    merged = telemetry.merge_traces(tr(1, 1000.0, 50.0, 50.0 * 1e6),
+                                    tr(1, 1000.0, 48.0, 48.0 * 1e6))
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert spans[0]["ts"] == pytest.approx(spans[1]["ts"])
+    # colliding pid: the second dump got a synthetic process track
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert len(pids) == 2 and 1 in pids
+    # metadata sorts first so Perfetto names tracks before events
+    assert merged["traceEvents"][0]["ph"] == "M"
+
+
+# ---- flight recorder ---------------------------------------------------
+
+def test_flight_recorder_rotation_retention_and_torn_tail(tmp_path):
+    from distkeras_tpu.flight_recorder import FlightRecorder
+
+    with pytest.raises(ValueError, match=">= 1"):
+        FlightRecorder(tmp_path, segment_events=0)
+    fr = FlightRecorder(tmp_path / "ring", segment_events=4,
+                        segments=2)
+    for i in range(20):
+        fr.record("tick", i=i)
+    fr.close()
+    fr.close()  # idempotent
+    # ring bound: 2 sealed segments x 4 events survive of the 20
+    events = fr.read_events()
+    assert [e["i"] for e in events] == list(range(12, 20))
+    assert all(e["kind"] == "tick" and "wall_s" in e and "pid" in e
+               for e in events)
+    # the caller's own fields never collide with recorder stamps
+    fr2 = FlightRecorder(tmp_path / "ring2")
+    fr2.record("commit", seq=41)
+    assert fr2.read_events()[0]["seq"] == 41
+    # torn final line (crashed writer): parsed up to the tear
+    with open(fr2._open_path(fr2._segment_n), "a") as f:
+        f.write('{"kind": "torn", "wal')
+    assert [e["kind"] for e in fr2.read_events()] == ["commit"]
+    # windowing: last N seconds ending at the newest event
+    assert fr2.last(60.0) == fr2.read_events()
+    assert fr2.last(0.0, until_wall_s=0.0) == []
+
+
+def test_flight_recorder_module_globals_and_disabled_noop(tmp_path):
+    from distkeras_tpu import flight_recorder
+
+    flight_recorder.stop()
+    assert flight_recorder.active() is None
+    flight_recorder.record("ignored", x=1)  # no recorder: no-op
+    flight_recorder.flush()
+    fr = flight_recorder.start(tmp_path / "fdr")
+    try:
+        assert flight_recorder.active() is fr
+        flight_recorder.record("seen", x=2)
+        flight_recorder.flush(fsync=True)
+        assert [e["kind"] for e in fr.read_events()] == ["seen"]
+    finally:
+        flight_recorder.stop()
+    assert flight_recorder.active() is None
+    # stopping sealed the live segment atomically
+    assert list((tmp_path / "fdr").glob("*.jsonl"))
+    assert not list((tmp_path / "fdr").glob("*.open"))
+
+
+# ---- acceptance: chaos + kill/restart, traced and flight-recorded -----
+
+def test_chaos_kill_restart_traced_flight_and_postmortem(tmp_path, tel):
+    """THE observability acceptance scenario (ISSUE 6): a chaos-enabled
+    socket training run whose external PS is killed and warm-restarted
+    mid-stream, observed end to end —
+
+    * the Perfetto trace validates WITH flow-event pairing: every
+      surviving commit's server ``ps_rpc`` handler span carries a
+      ``link_span`` that resolves to exactly one client-side wire span
+      (chaos-eaten sends leave legal orphan flow-starts).  The genuine
+      cross-PROCESS merge of the same arrows is proven by
+      ``scripts/trace_merge.py --smoke`` (tier-1 via test_examples);
+    * the flight recorder survives the crash with the whole story —
+      commits, snapshots, chaos injections, client retries, the
+      ``ps_kill`` marker, the ``ps_restart`` marker — and the max
+      commit seq per worker it recorded up to the restart marker
+      equals the restarted server's dedupe state exactly;
+    * ``scripts/postmortem.py``'s reconstruction finds the kill as the
+      crash marker (its exact snapshot ``acked_match`` law on a fully
+      sequential schedule is proven by ``postmortem.py --smoke``);
+    * the trainer's history carries the run's SLO verdict.
+    """
+    import importlib.util
+    import pathlib
+    import time
+
+    from distkeras_tpu import flight_recorder
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.flight_recorder import FlightRecorder
+    from distkeras_tpu.models import ModelSpec, model_config
+    from distkeras_tpu.parallel.faults import ChaosTransport
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    data = datasets.synthetic_classification(1024, (8,), 4, seed=0)
+    model = ModelSpec.from_config(mlp).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+
+    flight_dir = tmp_path / "flight"
+    snap = tmp_path / "ps.snap"
+    flight_recorder.start(flight_dir)
+    ps = HostParameterServer(DownpourRule(), center,
+                             snapshot_path=snap, snapshot_every=1)
+    srv = PSServer(ps, center).start()
+    port = srv.address[1]
+    box = {}
+
+    def killer():
+        while srv.ps.num_commits < 5:
+            time.sleep(0.002)
+        srv.kill()
+        # Let any commit already inside the handler finish its apply +
+        # snapshot before the restart loads the file: every commit
+        # RECORDED before the restart marker is then durably in the
+        # snapshot the restart resumes from.  (A commit CAN race the
+        # kill marker itself — real crash semantics — which is why the
+        # cross-check below anchors at the restart, not the kill.)
+        time.sleep(0.25)
+        for _ in range(50):
+            try:
+                box["srv2"] = PSServer.restart_from(
+                    snap, DownpourRule(), center, port=port)
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise OSError(f"could not rebind port {port}")
+
+    k = threading.Thread(target=killer)
+    k.start()
+    try:
+        with ChaosTransport(seed=7, reset_rate=0.05, max_injections=2,
+                            skip_ops=8):
+            t = DOWNPOUR(mlp, fidelity="host", transport="socket",
+                         num_workers=1, communication_window=2,
+                         batch_size=16, num_epoch=1,
+                         learning_rate=0.01, worker_optimizer="adam",
+                         worker_retries=12,
+                         ps_address=("127.0.0.1", port))
+            t.train(data, initial_variables=variables)
+    finally:
+        k.join()
+        flight_recorder.stop()
+    srv2 = box["srv2"]
+    srv2.stop()
+
+    # the outage really happened, the worker rode through it, and the
+    # run closed with an SLO verdict in the history
+    assert srv2.ps.num_commits > 5
+    assert t.history.get("worker_round_retries"), (
+        "the kill was invisible to the worker — test proved nothing")
+    assert t.history["slo_health"][-1] in ("ok", "degraded", "critical")
+
+    # -- trace: flow pairing + server->client span linking --------------
+    path = tel.tracer.write_chrome_trace(tmp_path / "trace.json")
+    trace = json.load(open(path))
+    check_perfetto_valid(trace)  # includes the flow-pairing contract
+    evs = trace["traceEvents"]
+    client_spans = {e["args"]["span_id"] for e in evs
+                    if e.get("ph") == "X"
+                    and e["name"] in ("ps_client_pull",
+                                      "ps_client_commit")}
+    rpc = [e for e in evs if e.get("ph") == "X"
+           and e["name"] == "ps_rpc"]
+    linked = [e for e in rpc if "link_span" in e["args"]]
+    assert linked, "no handler span recorded a client link"
+    for e in linked:
+        assert e["args"]["link_span"] in client_spans, e
+    assert any(e.get("ph") == "f" for e in evs)  # arrows really drawn
+
+    # -- flight recorder: the whole crash story survived ----------------
+    events = FlightRecorder(flight_dir).read_events()
+    kinds = {e["kind"] for e in events}
+    assert {"commit", "snapshot", "retry",
+            "ps_kill", "ps_restart"} <= kinds, kinds
+    assert "chaos" in kinds, "no chaos injection fired"
+
+    # the postmortem law, anchored at the restart marker: the max seq
+    # the flight ring recorded per worker up to the restart equals the
+    # dedupe state the restarted server resumed with
+    restart_ev = [e for e in events if e["kind"] == "ps_restart"][-1]
+    acked: dict = {}
+    for e in events:
+        if e["kind"] in ("commit", "commit_dedup") \
+                and e["wall_s"] <= restart_ev["wall_s"]:
+            w = str(e["worker"])
+            acked[w] = max(acked.get(w, -1), int(e["seq"]))
+    assert acked == {w: int(s)
+                     for w, s in restart_ev["last_acked"].items()}
+
+    # -- scripts/postmortem.py reconstructs the same crash --------------
+    pm_path = (pathlib.Path(__file__).resolve().parent.parent
+               / "scripts" / "postmortem.py")
+    spec = importlib.util.spec_from_file_location("_dkt_pm", pm_path)
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    report = pm.reconstruct(str(flight_dir), seconds=300.0)
+    assert report["crash"]["kind"] == "ps_kill"
+    assert report["kinds"].get("commit", 0) >= 5
+    # flight-acked at the KILL can trail the restart state by whatever
+    # was mid-handler when the crash hit, but can never lead it
+    for w, s in report["flight_last_acked"].items():
+        assert int(s) <= acked[w]
+    assert "postmortem" in pm.render(report)
