@@ -31,6 +31,12 @@ from distkeras_tpu.trainers import (  # noqa: F401
 )
 from distkeras_tpu.predictors import ModelPredictor  # noqa: F401
 from distkeras_tpu.serving import DecodeEngine, ShedError  # noqa: F401
+from distkeras_tpu.gateway import (  # noqa: F401
+    EngineReplica,
+    ReplicaServer,
+    RemoteReplica,
+    ServingGateway,
+)
 from distkeras_tpu.streaming import (  # noqa: F401
     StreamingGenerator,
     StreamingPredictor,

@@ -135,6 +135,25 @@ def ps_snapshot_info(path: str | os.PathLike) -> dict:
     }
 
 
+def ps_snapshot_center(snapshot: dict | str | os.PathLike) -> Pytree:
+    """The center parameter tree of a PS snapshot (dict or file) —
+    both the unsharded and the sharded formats store the assembled
+    ``"center"`` at the top level.  This is the serving side's entry
+    point: ``ServingGateway.rolling_update(path)`` resolves its new
+    weights through here, connecting the training half of the repo
+    (PS snapshots) to the serving half (hot weight swaps) without
+    needing the rule, clocks, or dedupe state a full
+    ``from_snapshot`` restore would."""
+    if isinstance(snapshot, (str, os.PathLike)):
+        snapshot = load_ps_snapshot(snapshot)
+    if "center" not in snapshot:
+        raise ValueError(
+            "not a PS snapshot: no 'center' key (expected a file "
+            "written by save_ps_snapshot / HostParameterServer."
+            "save_snapshot / ShardedParameterServer.save_snapshot)")
+    return snapshot["center"]
+
+
 SHARDED = "ckpt_sharded"
 _POINTER = "LATEST"
 

@@ -1,0 +1,1157 @@
+"""Serving gateway — multi-replica routing, failover, and rolling
+weight updates in front of N ``DecodeEngine``s.
+
+``DecodeEngine`` is deliberately single-driver: one thread steps the
+compiled programs, and the engine's own lock only makes ``submit``
+safe, not ``step``.  That leaves three production gaps this module
+closes (the serving-side mirror of what ``ResilientPSClient`` /
+``PSServer.restart_from`` already give the training side):
+
+* **Routing** — ``ServingGateway`` spreads requests over K replicas
+  under a pluggable policy: ``round_robin`` (fair under uniform
+  traffic), ``least_loaded`` (queue-depth + slot-occupancy aware, the
+  right default under ragged decode lengths), or ``session`` (sticky
+  key-hash affinity, so a conversation keeps hitting the replica that
+  holds its KV prefix warm).
+* **Failover** — a replica erroring, shedding, or dying mid-stream
+  does not fail the request: the gateway reschedules it onto another
+  replica under the same seeded full-jitter backoff discipline as
+  ``ResilientPSClient``, and first-completion-wins futures make
+  delivery exactly-once even when a timed-out attempt later limps
+  home.  Each engine's in-flight ``request_id`` dedupe keeps a single
+  engine at-most-once; a killed replica's in-flight requests complete
+  elsewhere (the chaos test pins this).
+* **Rolling weight updates** — ``rolling_update(source)`` pulls new
+  weights from a live parameter server (``HostParameterServer`` /
+  ``ShardedParameterServer`` / a PS client), a PS snapshot file
+  (``checkpoint.ps_snapshot_center``), or a raw pytree, then drains
+  and hot-swaps ONE replica at a time (``DecodeEngine.
+  swap_variables`` — same treedef/shapes, zero recompiles) while the
+  others keep serving.  After each swap the replica's health is
+  re-checked; a ``critical`` verdict rolls every already-updated
+  replica back to the pre-rollout weights.
+
+Replica arms:
+
+* ``EngineReplica`` — in-process: wraps one engine with its own
+  driver thread and a mailbox, so submission is thread-safe by
+  construction and weight swaps land exactly at step boundaries.
+* ``ReplicaServer`` / ``RemoteReplica`` — the socket arm: the same
+  replica served over ``parallel.transport`` framing (msgpack
+  payloads via ``pack_obj``, never pickle), with ``trace_header()``
+  propagation so gateway→replica spans pair up in a merged Perfetto
+  timeline, and the ``parallel.faults.ChaosTransport`` choke point in
+  the path (``target_ports={replica_port}`` attacks just this hop).
+  ``ReplicaServer.kill()`` severs the wire AND the driver — the crash
+  the failover machinery exists for.
+
+Observability: ``gateway_requests_total{replica,policy}`` /
+``gateway_failovers_total{replica}`` counters (their ratio is the
+watchdog's ``failover_rate`` signal), swap/rollout spans, and flight-
+recorder events — ``replica_down``, ``failover``, ``weight_swap``,
+``rollback`` — so a postmortem can replay a rollout or a crash story
+from disk.  ``healthz()`` aggregates per-replica verdicts into one
+gateway state.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import queue
+import socket
+import threading
+import zlib
+from typing import Any, Callable, Iterable, Iterator, Mapping, Optional
+
+import jax
+import numpy as np
+
+from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.serving import ShedError
+
+_UNSET = object()
+
+POLICIES = ("round_robin", "least_loaded", "session")
+
+
+class ReplicaDown(ConnectionError):
+    """The addressed replica is dead (driver crashed, socket severed,
+    or stopped) — the gateway's cue to fail the attempt over.  A
+    ``ConnectionError`` subclass so transport-level and replica-level
+    failures share one retry classification."""
+
+
+class _Future:
+    """First-completion-wins result cell: ``set`` returns True only
+    for the first caller, so a late duplicate (a timed-out attempt
+    completing after its failover already won) is dropped — delivery
+    is exactly-once even when execution was not."""
+
+    __slots__ = ("_lock", "_event", "_result", "_set")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._result = None
+        self._set = False
+
+    def set(self, result) -> bool:
+        with self._lock:
+            if self._set:
+                return False
+            self._set = True
+            self._result = result
+        self._event.set()
+        return True
+
+    def ready(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("result not ready")
+        return self._result
+
+
+# ---------------------------------------------------------------------
+# in-process replica: one engine, one driver thread
+# ---------------------------------------------------------------------
+
+
+class EngineReplica:
+    """One ``DecodeEngine`` plus its own driver thread.
+
+    All interaction goes through a mailbox the driver consumes between
+    step quanta: ``dispatch`` enqueues a request (callback-style
+    completion), ``swap`` enqueues a weight swap (so it executes at a
+    step boundary by construction — the driver never holds a step
+    half-done), ``quiesce`` blocks until nothing is queued or live.
+    The engine itself is never touched from another thread, which is
+    exactly the threading contract ``DecodeEngine.step`` demands.
+
+    A driver crash (poisoned engine, injected kill) marks the replica
+    down, records a ``replica_down`` flight event, and fails every
+    pending request with ``ReplicaDown`` — the gateway then reroutes
+    them.  ``stop()`` is the graceful variant: in-flight requests come
+    back as the engine's ``error="engine_closed"`` results (which the
+    gateway also treats as failover-able, so stopping one replica for
+    maintenance loses nothing).
+    """
+
+    def __init__(self, engine, name: str = "replica0"):
+        self.engine = engine
+        self.name = str(name)
+        # RLock'd condition: load() re-enters from quiesce's wait loop
+        self._cv = threading.Condition(threading.RLock())
+        self._mailbox: collections.deque = collections.deque()
+        self._pending: dict[Any, Callable] = {}
+        self._alive = False
+        self._stop_req = False
+        self._killed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "EngineReplica":
+        if self._thread is not None:
+            return self
+        self._alive = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"dkt-replica-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Graceful shutdown: the driver exits, the engine is closed,
+        and in-flight requests are delivered as ``engine_closed``
+        error results (never silently dropped)."""
+        with self._cv:
+            self._stop_req = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def kill(self) -> None:
+        """Crash simulation: the driver dies at its next loop top as
+        if the process had — pending requests fail with
+        ``ReplicaDown`` and the gateway's failover takes over."""
+        with self._cv:
+            self._killed = True
+            self._cv.notify_all()
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    # -- gateway-facing surface ---------------------------------------
+
+    def load(self) -> int:
+        """Requests owned by this replica (queued in the mailbox or in
+        the engine) — the ``least_loaded`` routing signal."""
+        with self._cv:
+            return len(self._pending) + sum(
+                1 for c in self._mailbox if c[0] == "submit")
+
+    def dispatch(self, spec: Mapping, on_result: Callable) -> None:
+        """Enqueue one request; ``on_result(result_or_exception)``
+        fires exactly once from the driver thread."""
+        with self._cv:
+            if not self._alive:
+                raise ReplicaDown(f"replica {self.name} is down")
+            self._mailbox.append(("submit", dict(spec), on_result))
+            self._cv.notify_all()
+
+    def swap(self, variables: Mapping,
+             timeout: float = 60.0) -> None:
+        """Install new weights at the next step boundary (blocks until
+        the driver has executed the swap); raises on mismatch."""
+        fut = _Future()
+        with self._cv:
+            if not self._alive:
+                raise ReplicaDown(f"replica {self.name} is down")
+            self._mailbox.append(("swap", variables, fut.set))
+            self._cv.notify_all()
+        res = fut.wait(timeout)
+        if isinstance(res, Exception):
+            raise res
+
+    def variables(self) -> Mapping:
+        """The engine's current weights (read-only use: the rollback
+        snapshot).  Safe without the driver — ``swap_variables``
+        replaces the whole dict atomically under the engine lock."""
+        return self.engine.variables
+
+    def quiesce(self, timeout: float = 60.0) -> None:
+        """Block until the replica holds no work (the drain step of a
+        rolling update — the gateway stops routing here first)."""
+        deadline = telemetry.now() + timeout
+        with self._cv:
+            while self.load() > 0:
+                left = deadline - telemetry.now()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"replica {self.name} did not quiesce within "
+                        f"{timeout}s ({self.load()} in flight)")
+                self._cv.wait(min(left, 0.1))
+
+    def health(self) -> dict:
+        """Liveness + load + the engine's SLO verdict."""
+        if not self._alive:
+            return {"alive": False, "state": "down", "load": 0}
+        return {"alive": True, "load": self.load(),
+                **self.engine.health()}
+
+    # -- driver -------------------------------------------------------
+
+    def _loop(self) -> None:
+        eng = self.engine
+        try:
+            while True:
+                with self._cv:
+                    while (not self._mailbox and not self._stop_req
+                           and not self._killed
+                           and not eng.has_work()):
+                        # bounded wait: has_work() can also change via
+                        # the engine's own deadline clock
+                        self._cv.wait(0.05)
+                    if self._killed:
+                        raise ReplicaDown(
+                            f"replica {self.name}: killed")
+                    if self._stop_req:
+                        break
+                    cmds = list(self._mailbox)
+                    self._mailbox.clear()
+                for cmd in cmds:
+                    self._exec(cmd)
+                if eng.has_work():
+                    for res in eng.step():
+                        self._deliver(res)
+                with self._cv:
+                    self._cv.notify_all()  # wake quiesce()
+        except BaseException as e:  # driver death == replica death
+            self._die(e)
+            return
+        self._shutdown()
+
+    def _exec(self, cmd) -> None:
+        if cmd[0] == "swap":
+            _, variables, done = cmd
+            try:
+                self.engine.swap_variables(variables)
+                done(None)
+            except Exception as e:
+                done(e)
+            return
+        _, spec, cb = cmd
+        kwargs = {}
+        for k in ("max_new_tokens", "eos_id", "deadline", "meta"):
+            if k in spec:
+                kwargs[k] = spec[k]
+        try:
+            rid = self.engine.submit(spec["prompt"],
+                                     request_id=spec["request_id"],
+                                     **kwargs)
+        except Exception as e:  # ShedError, validation, closed engine
+            cb(e)
+            return
+        with self._cv:
+            self._pending[rid] = cb
+
+    def _deliver(self, res: dict) -> None:
+        with self._cv:
+            cb = self._pending.pop(res["request_id"], None)
+            if not self._pending and not self._mailbox:
+                self._cv.notify_all()
+        if cb is not None:
+            cb(res)
+
+    def _take_all(self) -> tuple[dict, list]:
+        with self._cv:
+            self._alive = False
+            pending, self._pending = self._pending, {}
+            cmds = list(self._mailbox)
+            self._mailbox.clear()
+            self._cv.notify_all()
+        return pending, cmds
+
+    def _fail_cmds(self, cmds, exc: Exception) -> None:
+        # both command kinds carry their callback third; both accept
+        # an exception as the terminal outcome
+        for cmd in cmds:
+            with contextlib.suppress(Exception):
+                cmd[2](exc)
+
+    def _die(self, exc: BaseException) -> None:
+        pending, cmds = self._take_all()
+        telemetry.metrics().counter("gateway_replica_down_total",
+                                    replica=self.name).inc()
+        flight_recorder.record("replica_down", replica=self.name,
+                               error=repr(exc))
+        flight_recorder.flush()
+        with contextlib.suppress(Exception):
+            self.engine.close()  # release pools; results irrelevant
+        down = ReplicaDown(f"replica {self.name} died: {exc!r}")
+        for cb in pending.values():
+            with contextlib.suppress(Exception):
+                cb(down)
+        self._fail_cmds(cmds, down)
+
+    def _shutdown(self) -> None:
+        pending, cmds = self._take_all()
+        try:
+            results = {r["request_id"]: r
+                       for r in self.engine.close()}
+        except Exception:
+            results = {}
+        down = ReplicaDown(f"replica {self.name} stopped")
+        for rid, cb in pending.items():
+            with contextlib.suppress(Exception):
+                cb(results.get(rid, down))
+        self._fail_cmds(cmds, down)
+
+
+# ---------------------------------------------------------------------
+# socket arm
+# ---------------------------------------------------------------------
+#
+# Protocol (every message framed by ``transport``, an optional 17-byte
+# trace-context header first, then a command byte):
+#   b"g" + pack_obj(spec)      -> pack_obj(result dict)   (generate)
+#   b"h"                       -> pack_obj(health dict)
+#   b"w" + pack_obj(variables) -> pack_obj({"ok"| "error"}) (swap)
+#   b"v"                       -> pack_obj(variables)     (rollback src)
+#   b"q"                       -> pack_obj({"ok"| "error"}) (quiesce)
+#   b"s"                       -> connection closes        (stop server)
+# Payloads are flax msgpack (``pack_obj``) — self-describing, never
+# pickle; a generate connection stays open for the whole request, so a
+# severed wire maps 1:1 to a failed attempt.
+
+
+def _exc_error(e: Exception) -> str:
+    if isinstance(e, ShedError):
+        return f"shed: {e}"
+    if isinstance(e, ReplicaDown):
+        return f"replica_down: {e}"
+    return f"replica_error: {e!r}"
+
+
+class ReplicaServer:
+    """Serve one ``EngineReplica`` over the socket transport.
+
+    Mirrors ``PSServer``'s accept-loop shape (daemon handler thread
+    per connection, 0.2s accept poll, trace-linked rpc spans), so the
+    chaos and tracing machinery built for the PS wire applies
+    unchanged to the serving wire.
+    """
+
+    def __init__(self, replica: EngineReplica,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.replica = replica
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET,
+                              socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = self._sock.getsockname()
+        self._conns: list[socket.socket] = []
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"dkt-replica-srv-{replica.name}")
+
+    def start(self) -> "ReplicaServer":
+        self.replica.start()
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.2)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+                self._conns.append(conn)
+                threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            with contextlib.suppress(OSError):
+                self._sock.close()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                while True:
+                    msg = transport.recv_msg(conn)
+                    link, msg = transport.split_trace_header(msg)
+                    cmd, body = bytes(msg[:1]), msg[1:]
+                    with contextlib.ExitStack() as rpc:
+                        if link is not None:
+                            rpc.enter_context(telemetry.span(
+                                "replica_rpc", cmd=cmd.decode(),
+                                replica=self.replica.name,
+                                link_trace=format(link[0], "x"),
+                                link_span=format(link[1], "x")))
+                            telemetry.flow_end("wire", link[1],
+                                               cmd=cmd.decode())
+                        self._dispatch(conn, cmd, body)
+                    if self._stop.is_set():
+                        return
+            except (ConnectionError, OSError):
+                return  # client gone / chaos-severed
+
+    def _dispatch(self, conn: socket.socket, cmd: bytes,
+                  body: bytes) -> None:
+        rep = self.replica
+        if cmd == b"g":
+            spec = transport.unpack_obj(body)
+            spec["prompt"] = np.asarray(spec["prompt"], np.int32)
+            fut = _Future()
+            try:
+                rep.dispatch(spec, fut.set)
+                res = fut.wait()
+            except Exception as e:
+                res = e
+            if isinstance(res, Exception):
+                res = {"request_id": spec.get("request_id"),
+                       "prompt": spec["prompt"],
+                       "tokens": np.zeros((0,), np.int32),
+                       "error": _exc_error(res)}
+            transport.send_msg(conn, transport.pack_obj(
+                jax.device_get(res)))
+        elif cmd == b"h":
+            transport.send_msg(conn,
+                               transport.pack_obj(rep.health()))
+        elif cmd == b"w":
+            try:
+                rep.swap(transport.unpack_obj(body))
+                out = {"ok": True}
+            except Exception as e:
+                out = {"error": _exc_error(e)}
+            transport.send_msg(conn, transport.pack_obj(out))
+        elif cmd == b"v":
+            transport.send_msg(conn, transport.pack_obj(
+                jax.device_get(rep.variables())))
+        elif cmd == b"q":
+            try:
+                rep.quiesce()
+                out = {"ok": True}
+            except Exception as e:
+                out = {"error": _exc_error(e)}
+            transport.send_msg(conn, transport.pack_obj(out))
+        elif cmd == b"s":
+            self.stop()
+        else:
+            raise ValueError(f"unknown command {cmd!r}")
+
+    def stop(self) -> None:
+        """Graceful: stop accepting; live requests finish; the replica
+        (and its engine) shut down cleanly."""
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        self.replica.stop()
+
+    def kill(self) -> None:
+        """Crash simulation: sever the listener, every live
+        connection, AND the driver — clients see ``ConnectionError``
+        mid-frame and the gateway fails their requests over.  The
+        flight marker is fsynced first, as on ``PSServer.kill``."""
+        flight_recorder.record("replica_down",
+                               replica=self.replica.name,
+                               error="killed", port=self.address[1])
+        flight_recorder.flush(fsync=True)
+        self._stop.set()
+        for s in (self._sock, *self._conns):
+            with contextlib.suppress(OSError):
+                s.close()
+        self.replica.kill()
+
+
+class RemoteReplica:
+    """Gateway-side proxy for a ``ReplicaServer``.
+
+    Each generate attempt runs on its own dispatch thread over its own
+    connection (``trace_header()`` + ``flow_start`` pair the client
+    span with the server's ``replica_rpc`` span in a merged trace), so
+    a severed wire fails exactly one attempt.  Any transport-level
+    failure marks the proxy down — the gateway stops routing here
+    until ``probe()`` succeeds again.
+    """
+
+    def __init__(self, host: str, port: int,
+                 name: Optional[str] = None, *,
+                 attempt_timeout: Optional[float] = None,
+                 connect_timeout: float = 5.0):
+        self.host = host
+        self.port = int(port)
+        self.name = name if name is not None else f"{host}:{port}"
+        self.attempt_timeout = attempt_timeout
+        self.connect_timeout = connect_timeout
+        self._alive = True
+        self._lock = threading.Lock()
+        self._outstanding = 0
+
+    def start(self) -> "RemoteReplica":
+        return self  # the server owns the engine lifecycle
+
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    def load(self) -> int:
+        return self._outstanding
+
+    def _exchange(self, cmd: bytes, body: bytes = b"",
+                  timeout: Optional[float] = None):
+        # transport.* looked up at call time: the ChaosTransport choke
+        # point must see this hop
+        sock = transport.connect(self.host, self.port,
+                                 timeout=self.connect_timeout)
+        try:
+            if timeout is not None:
+                sock.settimeout(timeout)
+            hdr = transport.trace_header()
+            transport.send_msg(sock, hdr + cmd, body)
+            if hdr:
+                ctx = telemetry.current_trace()
+                telemetry.flow_start("wire", ctx[1],
+                                     cmd=cmd.decode())
+            return transport.unpack_obj(transport.recv_msg(sock))
+        finally:
+            with contextlib.suppress(OSError):
+                sock.close()
+
+    def _mark_down(self, exc: Exception) -> None:
+        with self._lock:
+            was = self._alive
+            self._alive = False
+        if was:
+            telemetry.metrics().counter("gateway_replica_down_total",
+                                        replica=self.name).inc()
+            flight_recorder.record("replica_down", replica=self.name,
+                                   error=repr(exc))
+            flight_recorder.flush()
+
+    def probe(self) -> bool:
+        """One health round-trip; revives a down-marked proxy when the
+        server is reachable again (the warm-restart story)."""
+        try:
+            self._exchange(b"h", timeout=self.connect_timeout)
+        except (ConnectionError, OSError, ValueError):
+            return False
+        self._alive = True
+        return True
+
+    def dispatch(self, spec: Mapping, on_result: Callable) -> None:
+        if not self._alive:
+            raise ReplicaDown(f"replica {self.name} is down")
+        with self._lock:
+            self._outstanding += 1
+        threading.Thread(target=self._run_request,
+                         args=(dict(spec), on_result),
+                         daemon=True).start()
+
+    def _run_request(self, spec: dict, on_result: Callable) -> None:
+        try:
+            with telemetry.span("gateway_rpc", replica=self.name,
+                                request_id=str(spec["request_id"])):
+                wire = dict(spec)
+                wire["prompt"] = np.asarray(spec["prompt"], np.int32)
+                out = self._exchange(
+                    b"g", transport.pack_obj(wire),
+                    timeout=self.attempt_timeout)
+                if isinstance(out.get("tokens"), np.ndarray):
+                    out["tokens"] = out["tokens"].astype(np.int32)
+        except Exception as e:
+            self._mark_down(e)
+            out = e
+        finally:
+            with self._lock:
+                self._outstanding -= 1
+        on_result(out)
+
+    def swap(self, variables: Mapping,
+             timeout: float = 120.0) -> None:
+        out = self._exchange(
+            b"w", transport.pack_obj(jax.device_get(dict(variables))),
+            timeout=timeout)
+        if "error" in out:
+            raise ValueError(f"remote swap failed: {out['error']}")
+
+    def variables(self) -> Mapping:
+        return self._exchange(b"v", timeout=120.0)
+
+    def quiesce(self, timeout: float = 60.0) -> None:
+        out = self._exchange(b"q", timeout=timeout)
+        if "error" in out:
+            raise TimeoutError(
+                f"remote quiesce failed: {out['error']}")
+
+    def health(self) -> dict:
+        try:
+            return self._exchange(b"h",
+                                  timeout=self.connect_timeout)
+        except (ConnectionError, OSError, ValueError):
+            return {"alive": False, "state": "down", "load": 0}
+
+    def stop_server(self) -> None:
+        with contextlib.suppress(ConnectionError, OSError):
+            sock = transport.connect(self.host, self.port,
+                                     timeout=self.connect_timeout)
+            try:
+                transport.send_msg(sock, b"s")
+            finally:
+                with contextlib.suppress(OSError):
+                    sock.close()
+
+
+# ---------------------------------------------------------------------
+# the gateway
+# ---------------------------------------------------------------------
+
+
+class _GwRequest:
+    __slots__ = ("rid", "spec", "future", "attempts", "tried")
+
+    def __init__(self, rid, spec):
+        self.rid = rid
+        self.spec = spec
+        self.future = _Future()
+        self.attempts = 0  # failed attempts so far
+        self.tried: set = set()  # replica names already tried
+
+
+def _classify(res) -> str:
+    """``final`` (deliver as-is), ``failover`` (replica failed — count
+    + reroute), or ``shed`` (backpressure — retry after backoff
+    without calling it a failover)."""
+    if isinstance(res, ShedError):
+        return "shed"
+    if isinstance(res, (ReplicaDown, ConnectionError, OSError,
+                        TimeoutError)):
+        return "failover"
+    if isinstance(res, ValueError) and "in flight" in str(res):
+        # the id is still live on that engine (a slow attempt we
+        # failed over from) — route elsewhere, don't fail the request
+        return "failover"
+    if isinstance(res, Exception):
+        return "final"
+    err = res.get("error")
+    if err is None:
+        return "final"
+    err = str(err)
+    if err.startswith("shed"):
+        return "shed"
+    if err.startswith(("replica_down", "engine_closed")):
+        return "failover"
+    return "final"  # deadline_exceeded, prefill_failed, replica_error
+
+
+def _cause(res) -> str:
+    return repr(res) if isinstance(res, Exception) \
+        else str(res.get("error"))
+
+
+class ServingGateway:
+    """Route requests over replicas; fail over; roll weights.
+
+    Args:
+      replicas: ``EngineReplica`` / ``RemoteReplica`` instances (or
+        anything duck-typing their surface).  Names must be unique.
+      policy: ``round_robin`` | ``least_loaded`` | ``session`` (sticky
+        by the ``session=`` key passed to ``submit``; requests without
+        a session key fall back to round-robin).
+      retries: failed attempts per request beyond the first before the
+        request is completed as ``error="gateway_retries_exhausted"``.
+      backoff_base/backoff_max/jitter/seed: full-jitter exponential
+        backoff between attempts — the ``ResilientPSClient``
+        discipline (``delay = min(max, base * 2**(n-1)) * (1 -
+        jitter*u)``), seeded so a chaos sweep's retry timing is
+        reproducible.
+      deadline: default per-attempt decode budget handed to the
+        engine (seconds from engine admission; gateway queue/backoff
+        time is NOT counted — each attempt gets a fresh budget).
+
+    Delivery semantics: ``submit`` returns a request id;
+    ``result(rid)`` blocks for its single result.  Success results are
+    the engine's dicts verbatim; terminal failures come back as
+    ``error`` result dicts (never exceptions), matching the engine's
+    own error-row contract.  A request is delivered exactly once even
+    if two attempts both complete (first wins).
+    """
+
+    def __init__(self, replicas: Iterable, *,
+                 policy: str = "round_robin", retries: int = 3,
+                 backoff_base: float = 0.02, backoff_max: float = 0.5,
+                 jitter: float = 0.5, seed: int = 0,
+                 deadline: Optional[float] = None):
+        self._replicas = list(replicas)
+        if not self._replicas:
+            raise ValueError("ServingGateway needs >= 1 replica")
+        names = [r.name for r in self._replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; choose from {POLICIES}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0; got {retries}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter} outside [0, 1]")
+        self.policy = policy
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.RLock()
+        self._requests: dict[Any, _GwRequest] = {}
+        self._rr = 0
+        self._n_auto = itertools.count()
+        self._seq = itertools.count()  # retry-queue tiebreaker
+        self._updating: set = set()  # replica names mid-swap
+        self._closing = False
+        self._started = False
+        self._retry_q: queue.PriorityQueue = queue.PriorityQueue()
+        self._retry_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ServingGateway":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        for rep in self._replicas:
+            rep.start()
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, daemon=True,
+            name="dkt-gateway-retry")
+        self._retry_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Shut down: local replicas close their engines (in-flight
+        requests complete as ``engine_closed`` error results, without
+        failover); remote replica SERVERS are left running — they are
+        owned by whoever started them."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        self._retry_q.put((0.0, -1, None))  # wake + exit
+        for rep in self._replicas:
+            if isinstance(rep, EngineReplica):
+                rep.stop()
+        if self._retry_thread is not None:
+            self._retry_thread.join(5.0)
+        # anything still unresolved (e.g. queued behind a dead retry)
+        # is failed out rather than leaking a waiter forever
+        with self._lock:
+            reqs = list(self._requests.values())
+        for req in reqs:
+            if not req.future.ready():
+                self._complete(req, self._error_result(
+                    req, "gateway_closed"))
+
+    def __enter__(self) -> "ServingGateway":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               eos_id=_UNSET, request_id=None, deadline=_UNSET,
+               session=None, meta: Optional[Mapping] = None):
+        """Queue one request; returns its id.  ``session`` is the
+        affinity key for the ``session`` policy.  Explicit
+        ``request_id``s must be unique among unresolved gateway
+        requests (and msgpack-encodable for remote replicas)."""
+        self.start()
+        spec: dict = {"prompt": np.asarray(prompt, np.int32)}
+        if max_new_tokens is not None:
+            spec["max_new_tokens"] = int(max_new_tokens)
+        if eos_id is not _UNSET:
+            spec["eos_id"] = eos_id
+        dl = self.deadline if deadline is _UNSET else deadline
+        if dl is not None:
+            spec["deadline"] = float(dl)
+        if meta:
+            spec["meta"] = dict(meta)
+        if session is not None:
+            spec["session"] = session
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("gateway is closed")
+            if request_id is None:
+                rid = f"gw-{next(self._n_auto)}"
+                while rid in self._requests:
+                    rid = f"gw-{next(self._n_auto)}"
+            else:
+                rid = request_id
+                if rid in self._requests:
+                    raise ValueError(
+                        f"request_id {rid!r} is already in flight")
+            spec["request_id"] = rid
+            req = _GwRequest(rid, spec)
+            self._requests[rid] = req
+        self._dispatch(req)
+        return rid
+
+    def result(self, request_id, timeout: Optional[float] = None
+               ) -> dict:
+        """Block for (and consume) one request's result."""
+        with self._lock:
+            req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(f"unknown request_id {request_id!r}")
+        res = req.future.wait(timeout)
+        with self._lock:
+            self._requests.pop(request_id, None)
+        return res
+
+    def run(self, requests: Iterable, *, ordered: bool = True
+            ) -> Iterator[dict]:
+        """Serve an iterable to completion — the gateway-level
+        ``DecodeEngine.run``.  Items are prompts or mappings with
+        ``"prompt"`` (+ ``max_new_tokens``/``eos_id``/``session``/
+        ``deadline``; other keys ride into results as meta).  Engine
+        sheds are absorbed by the failover/backoff machinery, so the
+        whole iterable is always accounted for: one result per item.
+        """
+        rids = [self._submit_item(item) for item in requests]
+        if ordered:
+            for rid in rids:
+                yield self.result(rid)
+            return
+        pending = set(rids)
+        while pending:
+            done = [rid for rid in pending
+                    if self._requests[rid].future.ready()]
+            for rid in done:
+                pending.discard(rid)
+                yield self.result(rid)
+            if not done:
+                _sleep(0.002)
+
+    def _submit_item(self, item):
+        if isinstance(item, Mapping):
+            meta = {k: v for k, v in item.items()
+                    if k not in ("prompt", "max_new_tokens", "eos_id",
+                                 "session", "deadline")}
+            return self.submit(
+                item["prompt"],
+                max_new_tokens=item.get("max_new_tokens"),
+                eos_id=item.get("eos_id", _UNSET),
+                deadline=item.get("deadline", _UNSET),
+                session=item.get("session"), meta=meta)
+        return self.submit(item)
+
+    # -- routing ------------------------------------------------------
+
+    def _choosable(self) -> list:
+        return [r for r in self._replicas
+                if r.alive and r.name not in self._updating]
+
+    def _choose(self, req: _GwRequest):
+        with self._lock:
+            cands = self._choosable()
+            if not cands:
+                return None
+            fresh = [r for r in cands if r.name not in req.tried]
+            cands = fresh or cands  # all tried: go around again
+            if self.policy == "least_loaded":
+                return min(cands, key=lambda r: (r.load(), r.name))
+            if (self.policy == "session"
+                    and req.spec.get("session") is not None):
+                cands = sorted(cands, key=lambda r: r.name)
+                key = str(req.spec["session"]).encode()
+                return cands[zlib.crc32(key) % len(cands)]
+            rep = cands[self._rr % len(cands)]
+            self._rr += 1
+            return rep
+
+    def _dispatch(self, req: _GwRequest) -> None:
+        rep = self._choose(req)
+        if rep is None:
+            # nothing routable: down-marked remotes may only have had
+            # a transient wire fault — probe before burning an attempt
+            for r in self._replicas:
+                probe = getattr(r, "probe", None)
+                if probe is not None and not r.alive:
+                    with contextlib.suppress(Exception):
+                        probe()
+            rep = self._choose(req)
+        if rep is None:
+            # nothing routable NOW (all down or mid-update): burn one
+            # attempt waiting rather than failing a survivable blip
+            self._retry(req, None, "no_replica_available",
+                        kind="failover")
+            return
+        req.tried.add(rep.name)
+        telemetry.metrics().counter("gateway_requests_total",
+                                    replica=rep.name,
+                                    policy=self.policy).inc()
+        try:
+            rep.dispatch(req.spec,
+                         lambda res: self._on_result(req, rep, res))
+        except Exception as e:  # refused at the door (down/racing)
+            self._on_result(req, rep, e)
+
+    def _on_result(self, req: _GwRequest, rep, res) -> None:
+        if req.future.ready():
+            return  # a faster attempt already won
+        kind = _classify(res)
+        if self._closing or kind == "final":
+            self._complete(req, res)
+            return
+        name = rep.name if rep is not None else "(none)"
+        if kind == "failover":
+            telemetry.metrics().counter("gateway_failovers_total",
+                                        replica=name).inc()
+            flight_recorder.record("failover", request_id=req.rid,
+                                   replica=name, cause=_cause(res),
+                                   attempt=req.attempts + 1)
+        else:
+            telemetry.metrics().counter("gateway_shed_retries_total",
+                                        replica=name).inc()
+        self._retry(req, rep, _cause(res), kind=kind)
+
+    def _retry(self, req: _GwRequest, rep, cause: str, *,
+               kind: str) -> None:
+        req.attempts += 1
+        if req.attempts > self.retries:
+            telemetry.metrics().counter(
+                "gateway_retries_exhausted_total").inc()
+            self._complete(req, self._error_result(
+                req, f"gateway_retries_exhausted: {cause}"))
+            return
+        self._retry_q.put((telemetry.now()
+                           + self._backoff_delay(req.attempts),
+                           next(self._seq), req))
+
+    def _backoff_delay(self, attempt: int) -> float:
+        delay = min(self.backoff_max,
+                    self.backoff_base * 2 ** (attempt - 1))
+        with self._lock:
+            u = float(self._rng.random())
+        return delay * (1.0 - self.jitter * u)
+
+    def _retry_loop(self) -> None:
+        while True:
+            due, _, req = self._retry_q.get()
+            if req is None:
+                return
+            wait = due - telemetry.now()
+            if wait > 0:
+                _sleep(wait)
+            if self._closing:
+                if not req.future.ready():
+                    self._complete(req, self._error_result(
+                        req, "gateway_closed"))
+                continue
+            self._dispatch(req)
+
+    def _complete(self, req: _GwRequest, res) -> None:
+        if isinstance(res, Exception):
+            res = self._error_result(req, f"gateway: {res!r}")
+        req.future.set(res)
+
+    def _error_result(self, req: _GwRequest, error: str) -> dict:
+        spec = req.spec
+        return {**spec.get("meta", {}),
+                "request_id": req.rid, "prompt": spec["prompt"],
+                "tokens": np.zeros((0,), np.int32), "error": error,
+                "attempts": req.attempts}
+
+    # -- health -------------------------------------------------------
+
+    def healthz(self) -> dict:
+        """Aggregated verdict + per-replica verdicts.  ``critical``
+        when no replica is alive; otherwise the worst alive replica's
+        SLO state, floored at ``degraded`` while any replica is down
+        or mid-update (capacity is reduced even if the survivors are
+        healthy)."""
+        rank = {"ok": 0, "degraded": 1, "critical": 2}
+        replicas = {}
+        worst, n_alive = "ok", 0
+        with self._lock:
+            updating = set(self._updating)
+        for rep in self._replicas:
+            h = rep.health()
+            replicas[rep.name] = h
+            if h.get("alive"):
+                n_alive += 1
+                s = h.get("state", "ok")
+                if rank.get(s, 0) > rank[worst]:
+                    worst = s
+        if n_alive == 0:
+            state = "critical"
+        elif n_alive < len(self._replicas) or updating:
+            state = worst if rank[worst] >= 1 else "degraded"
+        else:
+            state = worst
+        telemetry.metrics().gauge("gateway_alive_replicas").set(
+            n_alive)
+        return {"state": state, "alive": n_alive,
+                "total": len(self._replicas),
+                "updating": sorted(updating), "replicas": replicas}
+
+    # -- rolling weight updates ---------------------------------------
+
+    def _resolve_source(self, source) -> dict:
+        """New weights from: a PS snapshot path, a live PS (``.center``
+        — ``HostParameterServer`` / ``ShardedParameterServer``), a PS
+        client (``.pull()``), a ``{"params": ...}`` variables dict, or
+        a raw parameter pytree."""
+        import os
+
+        if isinstance(source, (str, os.PathLike)):
+            from distkeras_tpu import checkpoint
+
+            params = checkpoint.ps_snapshot_center(source)
+        elif hasattr(source, "center"):
+            params = source.center
+        elif hasattr(source, "pull") and callable(source.pull):
+            params = source.pull()
+        elif isinstance(source, Mapping) and "params" in source:
+            return dict(source)
+        else:
+            params = source
+        return {"params": params}
+
+    def rolling_update(self, source, *,
+                       quiesce_timeout: float = 60.0,
+                       health_check: Optional[Callable] = None
+                       ) -> dict:
+        """Drain + hot-swap one replica at a time while the rest keep
+        serving; zero requests fail (draining excludes the replica
+        from routing first, and the engine swap is rejected — not
+        applied — on any structure mismatch).
+
+        State machine per replica: *exclude from routing* → *quiesce*
+        (drain its in-flight work) → *swap* (step-boundary install,
+        no recompile) → *readmit* → *health re-check*.  If the check
+        (default: the replica's own SLO verdict; pass
+        ``health_check=lambda rep: ...`` to override) comes back
+        ``critical``, every already-updated replica is rolled back to
+        the pre-rollout weights and the rollout stops.  Dead replicas
+        are skipped (they pick up current weights on restart).
+
+        Returns ``{"updated": [...], "skipped": [...],
+        "rolled_back": bool}``.
+        """
+        self.start()
+        new_vars = self._resolve_source(source)
+        check = health_check or (lambda rep: rep.health())
+        report: dict = {"updated": [], "skipped": [],
+                        "rolled_back": False}
+        live = [r for r in self._replicas if r.alive]
+        if not live:
+            raise ReplicaDown("rolling_update: no replica alive")
+        # the rollback image: the fleet is uniform between rollouts,
+        # so any live replica's weights are THE previous version
+        old_vars = jax.device_get(dict(live[0].variables()))
+        with telemetry.span("rolling_update",
+                            replicas=len(self._replicas)):
+            for rep in self._replicas:
+                if not rep.alive:
+                    report["skipped"].append(rep.name)
+                    continue
+                self._swap_one(rep, new_vars, quiesce_timeout)
+                verdict = check(rep)
+                if verdict.get("state") == "critical":
+                    self._rollback(report["updated"] + [rep.name],
+                                   old_vars, quiesce_timeout)
+                    report["rolled_back"] = True
+                    report["verdict"] = verdict
+                    return report
+                report["updated"].append(rep.name)
+        return report
+
+    def _swap_one(self, rep, variables: Mapping,
+                  quiesce_timeout: float) -> None:
+        with telemetry.span("weight_swap", replica=rep.name):
+            with self._lock:
+                self._updating.add(rep.name)
+            try:
+                rep.quiesce(quiesce_timeout)
+                rep.swap(variables)
+            finally:
+                with self._lock:
+                    self._updating.discard(rep.name)
+        telemetry.metrics().counter("gateway_weight_swaps_total",
+                                    replica=rep.name).inc()
+        flight_recorder.record("weight_swap", replica=rep.name)
+
+    def _rollback(self, names: list, old_vars: Mapping,
+                  quiesce_timeout: float) -> None:
+        telemetry.metrics().counter("gateway_rollbacks_total").inc()
+        flight_recorder.record("rollback", replicas=list(names))
+        flight_recorder.flush()
+        by_name = {r.name: r for r in self._replicas}
+        with telemetry.span("rollback", replicas=len(names)):
+            for name in names:
+                rep = by_name[name]
+                if rep.alive:
+                    self._swap_one(rep, old_vars, quiesce_timeout)
+
+
+def _sleep(seconds: float) -> None:
+    if seconds > 0:
+        import time
+
+        time.sleep(seconds)

@@ -81,6 +81,14 @@ class ChaosTransport:
         retries).
       skip_ops: operations at the very start of the run that are never
         faulted (lets the handshake/first pull establish a baseline).
+      target_ports: restrict injection to operations whose PEER port is
+        in this set (``None``: every operation is injectable — the
+        original behavior).  The rng is still consumed on EVERY op, so
+        the schedule stays a pure function of (seed, op index); only
+        the *firing* is filtered.  This is how a process hosting both a
+        PS and gateway replicas attacks ONE hop: e.g.
+        ``target_ports={replica_port}`` chaoses the gateway→replica
+        wire while the training exchange stays clean.
     """
 
     def __init__(self, seed: int = 0, *, reset_rate: float = 0.0,
@@ -89,7 +97,8 @@ class ChaosTransport:
                  partition_at: Optional[int] = None,
                  partition_ops: int = 4,
                  max_injections: Optional[int] = None,
-                 skip_ops: int = 0):
+                 skip_ops: int = 0,
+                 target_ports: Optional[set] = None):
         for name, rate in (("reset_rate", reset_rate),
                            ("truncate_rate", truncate_rate),
                            ("delay_rate", delay_rate)):
@@ -104,6 +113,8 @@ class ChaosTransport:
         self.partition_ops = int(partition_ops)
         self.max_injections = max_injections
         self.skip_ops = int(skip_ops)
+        self.target_ports = (None if target_ports is None
+                             else {int(p) for p in target_ports})
         self._lock = threading.Lock()
         self._op = 0
         self._injected = 0
@@ -121,10 +132,13 @@ class ChaosTransport:
         # scheduled this injection
         flight_recorder.record("chaos", fault=kind, op=self._op)
 
-    def _draw(self, op_kind: str):
+    def _draw(self, op_kind: str, port: Optional[int] = None):
         """One scheduled decision; returns the fault to inject (or
         None).  Called under the lock so op indices — and therefore the
-        rng stream — are globally ordered."""
+        rng stream — are globally ordered.  ``port`` is the operation's
+        peer port (None when unknowable, e.g. an already-dead socket):
+        with ``target_ports`` set, a non-targeted op still consumes its
+        rng draw but never fires."""
         with self._lock:
             op = self._op
             self._op += 1
@@ -133,7 +147,11 @@ class ChaosTransport:
             u = float(self._rng.random())
             if op < self.skip_ops:
                 return None
-            if (self.partition_at is not None and op_kind == "connect"
+            targeted = (self.target_ports is None
+                        or (port is not None
+                            and port in self.target_ports))
+            if (targeted and self.partition_at is not None
+                    and op_kind == "connect"
                     and self.partition_at <= op
                     < self.partition_at + self.partition_ops):
                 self._note("partition")
@@ -146,6 +164,8 @@ class ChaosTransport:
                 if u < edge:
                     if kind == "truncate" and op_kind != "send":
                         return None  # only sends can truncate
+                    if not targeted:
+                        return None  # drawn, but this hop is off-limits
                     if kind in ("reset", "truncate"):
                         if not budget_left:
                             return None
@@ -157,7 +177,7 @@ class ChaosTransport:
     # -- wrapped operations ------------------------------------------------
 
     def _connect(self, host, port, timeout=None):
-        fault = self._draw("connect")
+        fault = self._draw("connect", port=int(port))
         if fault == "partition":
             raise ConnectionRefusedError(
                 "chaos: partitioned (scheduled one-shot window)")
@@ -169,7 +189,7 @@ class ChaosTransport:
         return self._orig[0](host, port, timeout=timeout)
 
     def _send_msg(self, sock, *parts):
-        fault = self._draw("send")
+        fault = self._draw("send", port=_peer_port(sock))
         if fault == "delay":
             telemetry.instant("chaos_delay", op="send")
             _sleep(self.delay_s)
@@ -193,7 +213,7 @@ class ChaosTransport:
             return float(self._rng.random())
 
     def _recv_msg(self, sock):
-        fault = self._draw("recv")
+        fault = self._draw("recv", port=_peer_port(sock))
         if fault == "delay":
             telemetry.instant("chaos_delay", op="recv")
             _sleep(self.delay_s)
@@ -207,7 +227,7 @@ class ChaosTransport:
         crosses the same choke point: same fault classes, same
         schedule stream.  Truncation materializes the frame (a copy is
         fine on the chaos path) to cut a strict prefix."""
-        fault = self._draw("send")
+        fault = self._draw("send", port=_peer_port(sock))
         if fault == "delay":
             telemetry.instant("chaos_delay", op="send")
             _sleep(self.delay_s)
@@ -227,7 +247,7 @@ class ChaosTransport:
         return self._orig[3](sock, *parts)
 
     def _recv_msg_into(self, sock):
-        fault = self._draw("recv")
+        fault = self._draw("recv", port=_peer_port(sock))
         if fault == "delay":
             telemetry.instant("chaos_delay", op="recv")
             _sleep(self.delay_s)
@@ -287,6 +307,16 @@ class ChaosTransport:
     @property
     def total_injected(self) -> int:
         return sum(self.counts.values())
+
+
+def _peer_port(sock) -> Optional[int]:
+    """Peer port of a connected socket (None when the socket is
+    already dead — with ``target_ports`` set such an op never fires,
+    the safe default for an unattributable operation)."""
+    try:
+        return int(sock.getpeername()[1])
+    except (OSError, IndexError, TypeError):
+        return None
 
 
 def _hard_close(sock) -> None:

@@ -143,6 +143,25 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def pack_obj(obj: Any) -> bytes:
+    """Self-describing msgpack encoding of a python object (dicts,
+    lists, scalars, numpy arrays — flax's msgpack extension).  The
+    serving-gateway wire uses this for request/result/health payloads,
+    which have no pre-shared template the raw ``pack_params`` encoding
+    could lean on.  Never pickle: nothing executable crosses the
+    wire."""
+    from flax import serialization as flax_serialization
+
+    return flax_serialization.msgpack_serialize(obj)
+
+
+def unpack_obj(data: bytes | memoryview) -> Any:
+    """Inverse of ``pack_obj`` (template-free)."""
+    from flax import serialization as flax_serialization
+
+    return flax_serialization.msgpack_restore(bytes(data))
+
+
 def recv_msg(sock: socket.socket) -> bytes:
     (length,) = _HEADER.unpack(_recvall(sock, _HEADER.size))
     if length > MAX_MSG_BYTES:

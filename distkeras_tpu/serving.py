@@ -62,6 +62,7 @@ timing stamps all read ``telemetry.now()`` — see ``_finish``.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Iterable, Iterator, Mapping, Optional
 
 import jax
@@ -245,6 +246,14 @@ class DecodeEngine:
         self._n_rng = 0
         self._n_submitted = 0
         self._inflight: set = set()  # rids queued or in a slot
+        # Admission lock: ``submit()`` is safe from any thread — it
+        # serializes the queue/rid/dedup mutations against the
+        # stepping thread's admission sweep (which pops under the same
+        # lock but prefills OUTSIDE it, so submitters never wait on a
+        # compiled program).  ``step()`` itself must still run on one
+        # thread at a time — the gateway's ``EngineReplica`` gives
+        # every engine a single driver thread by construction.
+        self._lock = threading.RLock()
         self._closed = False
         self._traces: collections.Counter = collections.Counter()
         if donate is None:
@@ -414,36 +423,41 @@ class DecodeEngine:
                 f"{dl}")
         pool = self._route(len(prompt), max_new)
         m = telemetry.metrics()
-        if (self.queue_bound is not None
-                and len(pool.queue) >= self.queue_bound):
-            m.counter("serving_shed_total", reason="queue_full",
-                      bucket=pool.env).inc()
-            flight_recorder.record("shed", reason="queue_full",
-                                   bucket=pool.env)
-            raise ShedError(
-                "queue_full",
-                f"bucket {pool.env} admission queue at its bound "
-                f"({self.queue_bound} waiting); request shed — "
-                "resubmit after draining")
-        if request_id is None:
-            rid = self._n_submitted
-            while rid in self._inflight:  # skip in-flight explicit ids
-                rid += 1
-        else:
-            rid = request_id
-            if rid in self._inflight:
-                raise ValueError(
-                    f"request_id {rid!r} is already in flight; "
-                    "duplicate ids would cross-deliver results")
-        req = _Request(rid, prompt, int(max_new), eos,
-                       dict(meta or {}), self._n_submitted, deadline=dl)
-        self._n_submitted += 1
-        self._inflight.add(rid)
-        pool.queue.append(req)
-        m.counter("serving_requests_total", bucket=pool.env).inc()
-        m.gauge("serving_queue_depth",
-                bucket=pool.env).set(len(pool.queue))
-        return req.rid
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "engine is closed; submit after close()")
+            if (self.queue_bound is not None
+                    and len(pool.queue) >= self.queue_bound):
+                m.counter("serving_shed_total", reason="queue_full",
+                          bucket=pool.env).inc()
+                flight_recorder.record("shed", reason="queue_full",
+                                       bucket=pool.env)
+                raise ShedError(
+                    "queue_full",
+                    f"bucket {pool.env} admission queue at its bound "
+                    f"({self.queue_bound} waiting); request shed — "
+                    "resubmit after draining")
+            if request_id is None:
+                rid = self._n_submitted
+                while rid in self._inflight:  # skip in-flight ids
+                    rid += 1
+            else:
+                rid = request_id
+                if rid in self._inflight:
+                    raise ValueError(
+                        f"request_id {rid!r} is already in flight; "
+                        "duplicate ids would cross-deliver results")
+            req = _Request(rid, prompt, int(max_new), eos,
+                           dict(meta or {}), self._n_submitted,
+                           deadline=dl)
+            self._n_submitted += 1
+            self._inflight.add(rid)
+            pool.queue.append(req)
+            m.counter("serving_requests_total", bucket=pool.env).inc()
+            m.gauge("serving_queue_depth",
+                    bucket=pool.env).set(len(pool.queue))
+            return req.rid
 
     def _next_rng(self):
         self._n_rng += 1
@@ -459,6 +473,53 @@ class DecodeEngine:
                 "mid-stream; drain the engine first")
         self._n_rng = 0
 
+    def swap_variables(self, variables: Mapping) -> None:
+        """Hot weight swap: install a new parameter pytree WITHOUT
+        recompiling — the compiled step/prefill programs take the
+        weights as an argument, so a same-structure tree reuses every
+        cached program (``compile_counts`` is unchanged by a swap; the
+        tier-1 swap test pins this).
+
+        The new tree must match the current one exactly in treedef,
+        leaf shapes, and dtypes — a mismatch would silently retrace
+        (new compiles mid-serving, the §23 bound broken), so it is
+        rejected HERE.  The swap takes effect at the next step
+        boundary: ``step()``/``_admit`` snapshot ``self.variables``
+        once per call, so in-flight requests finish their current
+        quantum on the old weights and every later token uses the new
+        ones.  KV caches are NOT invalidated — a mid-request swap
+        serves a hybrid prefix (standard rolling-serve semantics);
+        drain the engine first (the gateway's rolling update does)
+        when that matters."""
+        if self._closed:
+            raise RuntimeError("engine is closed; swap after close()")
+        new = dict(variables)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.variables)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            raise ValueError(
+                f"swap_variables structure mismatch: engine has "
+                f"{old_def}, got {new_def}")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            o_sh, n_sh = jnp.shape(o), jnp.shape(n)
+            o_dt = np.dtype(getattr(o, "dtype", np.asarray(o).dtype))
+            n_dt = np.dtype(getattr(n, "dtype", np.asarray(n).dtype))
+            if o_sh != n_sh or o_dt != n_dt:
+                raise ValueError(
+                    f"swap_variables leaf {i} mismatch: engine has "
+                    f"{o_sh}/{o_dt}, got {n_sh}/{n_dt} — a swap must "
+                    "not retrace the compiled programs")
+        # device_put up front (PS centers arrive as read-only host
+        # numpy): the step loop then reuses device buffers instead of
+        # re-transferring the tree every dispatch
+        new = jax.tree_util.tree_map(jnp.asarray, new)
+        with self._lock:
+            self.variables = new
+        telemetry.metrics().counter("serving_weight_swaps_total").inc()
+        telemetry.instant("weight_swap")
+        flight_recorder.record("weight_swap",
+                               leaves=len(new_leaves))
+
     def _note_gauges(self, pool: _Pool) -> None:
         """Per-bucket queue-depth / slot-occupancy gauges — the levels
         an operator correlates with a TTFT spike (no-op while
@@ -473,14 +534,15 @@ class DecodeEngine:
         """Sweep the admission queue for requests already past their
         deadline — they leave with an ``error`` result instead of
         consuming a prefill + slot they can no longer use."""
-        if not any(r.deadline is not None for r in pool.queue):
-            return []
-        now = telemetry.now()
-        expired, alive = [], collections.deque()
-        for req in pool.queue:
-            (expired if req.deadline is not None and now > req.deadline
-             else alive).append(req)
-        pool.queue = alive
+        with self._lock:
+            if not any(r.deadline is not None for r in pool.queue):
+                return []
+            now = telemetry.now()
+            expired, alive = [], collections.deque()
+            for req in pool.queue:
+                (expired if req.deadline is not None
+                 and now > req.deadline else alive).append(req)
+            pool.queue = alive
         m = telemetry.metrics()
         out = []
         for req in expired:
@@ -493,14 +555,19 @@ class DecodeEngine:
     def _admit(self) -> list[dict]:
         finished = []
         m = telemetry.metrics()
+        # weights are snapshotted ONCE per admission sweep, so a
+        # concurrent swap_variables takes effect at the next step
+        # boundary, never mid-sweep
+        variables = self.variables
         for pool in self._pools:
             finished.extend(self._shed_expired_queued(pool))
             for slot in range(pool.n_slots):
-                if not pool.queue:
-                    break
                 if pool.reqs[slot] is not None:
                     continue
-                req = pool.queue.popleft()
+                with self._lock:  # pop vs racing submit() appends
+                    if not pool.queue:
+                        break
+                    req = pool.queue.popleft()
                 t_p = len(req.prompt)
                 t_pad = min(pool.env,
                             _ceil_to(t_p, self.prefill_align))
@@ -511,7 +578,7 @@ class DecodeEngine:
                                         slot=slot, padded=t_pad,
                                         request_id=req.rid):
                         pool.cache, pool.state, tok0 = pool.prefill_fn(
-                            self.variables, pool.cache, pool.state,
+                            variables, pool.cache, pool.state,
                             jnp.asarray(padded), slot, t_p - 1,
                             req.max_new - 1,
                             -1 if req.eos_id is None else req.eos_id,
@@ -620,6 +687,9 @@ class DecodeEngine:
             raise RuntimeError("engine is closed; step after close()")
         finished = self._admit()
         m = telemetry.metrics()
+        # one weights snapshot per step: a concurrent swap_variables
+        # lands atomically at the next step boundary (see _admit)
+        variables = self.variables
         for pool in self._pools:
             if not pool.live():
                 continue
@@ -628,7 +698,7 @@ class DecodeEngine:
             with telemetry.span("decode_step", bucket=pool.env,
                                 steps=self.steps_per_sync):
                 pool.cache, pool.state, toks, was_done = pool.step_fn(
-                    self.variables, pool.cache, pool.state,
+                    variables, pool.cache, pool.state,
                     self._next_rng())
                 toks = np.asarray(toks)
                 was_done = np.asarray(was_done)
@@ -684,21 +754,23 @@ class DecodeEngine:
         device cache pools are released, and further ``submit``/
         ``step`` calls raise.  Call ``drain()`` first for a graceful
         shutdown that finishes the backlog instead."""
-        if self._closed:
-            return []
-        out = []
-        for pool in self._pools:
-            while pool.queue:
-                out.append(self._finish_error(
-                    pool.queue.popleft(), "engine_closed", pool.env))
-            for slot, req in enumerate(pool.reqs):
-                if req is not None:
-                    pool.reqs[slot] = None
+        with self._lock:
+            if self._closed:
+                return []
+            out = []
+            for pool in self._pools:
+                while pool.queue:
                     out.append(self._finish_error(
-                        req, "engine_closed", pool.env))
-            pool.cache = pool.state = None  # release the device pool
-            self._note_gauges(pool)
-        self._closed = True
+                        pool.queue.popleft(), "engine_closed",
+                        pool.env))
+                for slot, req in enumerate(pool.reqs):
+                    if req is not None:
+                        pool.reqs[slot] = None
+                        out.append(self._finish_error(
+                            req, "engine_closed", pool.env))
+                pool.cache = pool.state = None  # release the pool
+                self._note_gauges(pool)
+            self._closed = True
         flight_recorder.record("engine_closed", cancelled=len(out))
         flight_recorder.flush()
         return out
@@ -715,6 +787,20 @@ class DecodeEngine:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _submit_item(self, item):
+        """``run``'s item contract: a prompt array, or a mapping with
+        ``"prompt"`` (+ optional ``"max_new_tokens"``/``"eos_id"``;
+        other keys ride into the result as meta)."""
+        if isinstance(item, Mapping):
+            meta = {k: v for k, v in item.items()
+                    if k not in ("prompt", "max_new_tokens",
+                                 "eos_id")}
+            return self.submit(
+                item["prompt"],
+                max_new_tokens=item.get("max_new_tokens"),
+                eos_id=item.get("eos_id", _UNSET), meta=meta)
+        return self.submit(item)
+
     def run(self, requests: Iterable, *, ordered: bool = True
             ) -> Iterator[dict]:
         """Serve an iterable of requests to completion.
@@ -724,23 +810,44 @@ class DecodeEngine:
         carried into the result).  ``ordered=True`` yields results in
         submission order; ``False`` yields as completed (lower
         latency for early finishers).
+
+        With ``queue_bound`` set, a mid-iterable ``ShedError`` is
+        handled as BACKPRESSURE, not failure: submission pauses while
+        the engine steps (freeing queue space), then resumes — so
+        already-completed results are delivered, never discarded, and
+        deadline/poison casualties come back as ``error`` rows —
+        matching ``StreamingGenerator``'s backpressure contract.  The
+        whole iterable is always accounted for: one result per item.
         """
         order: list = []
-        for item in requests:
-            if isinstance(item, Mapping):
-                meta = {k: v for k, v in item.items()
-                        if k not in ("prompt", "max_new_tokens",
-                                     "eos_id")}
-                rid = self.submit(
-                    item["prompt"],
-                    max_new_tokens=item.get("max_new_tokens"),
-                    eos_id=item.get("eos_id", _UNSET), meta=meta)
-            else:
-                rid = self.submit(item)
-            order.append(rid)
         buffered: dict = {}
         next_emit = 0
-        while self.has_work():
+        stalled = None  # item shed at the door, awaiting capacity
+        it = iter(requests)
+        exhausted = False
+        while True:
+            # feed until a shed: ShedError here is backpressure — the
+            # stalled item waits while step() drains the queue
+            while not exhausted or stalled is not None:
+                if stalled is None:
+                    try:
+                        stalled = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                try:
+                    order.append(self._submit_item(stalled))
+                    stalled = None
+                except ShedError:
+                    break
+            if not self.has_work():
+                if exhausted and stalled is None:
+                    break
+                # queue_bound >= 1 guarantees an idle engine admits:
+                # a shed here means another consumer drained our work
+                raise RuntimeError(
+                    "run(): request shed while the engine is idle — "
+                    "the engine is being stepped/drained concurrently")
             for res in self.step():
                 if not ordered:
                     yield res

@@ -997,6 +997,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "queue_depth": (64.0, 256.0),         # queued requests, all buckets
     "ttft_p95_s": (1.0, 10.0),            # seconds to first token
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
+    "failover_rate": (0.05, 0.5),         # gateway failovers / request
 }
 
 
@@ -1082,6 +1083,13 @@ class SLOWatchdog:
         if registered > 0:
             idle = sum(m.value for _, m in r.collect("ps_idle_workers"))
             out["idle_worker_fraction"] = idle / registered
+        groutes = r.sum_counter("gateway_requests_total")
+        gfails = r.sum_counter("gateway_failovers_total")
+        if groutes or gfails:
+            # failovers per routed request: a replica flapping under
+            # the gateway shows up here even while every request still
+            # completes (the gateway hides the failures it absorbs)
+            out["failover_rate"] = gfails / max(groutes, 1.0)
         return out
 
     # -- evaluation ---------------------------------------------------

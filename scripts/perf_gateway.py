@@ -1,0 +1,387 @@
+"""Serving-gateway A/B + failover drill + rolling-update drill
+(ISSUE 7 satellite e): one workload, three questions.
+
+1. **A/B 1 vs K** — the SAME saturated backlog through a
+   ``ServingGateway`` over one in-process ``EngineReplica`` and then
+   over ``--replicas`` of them: aggregate goodput tokens/s and
+   queue-to-first-token p50/p95 per arm, plus the K-vs-1 speedup.
+   Engines are warmed (every padded prompt length + the step program)
+   before the timed run, so compile time never pollutes TTFT.
+2. **Failover drill** — K socket replicas (``ReplicaServer`` /
+   ``RemoteReplica``) under seeded ``ChaosTransport`` on the
+   gateway→replica hop; one replica is killed with the backlog in
+   flight.  Reports failover latency (kill → ``t_finish`` of each
+   request that failed over off the victim, p50/p95/max) and the
+   flight-recorder story (``replica_down`` → ``failover`` counts).
+3. **Rolling-update drill** — a live ``HostParameterServer`` holds
+   scaled weights; ``rolling_update(ps)`` swaps them into every
+   replica one at a time while a pump thread keeps traffic flowing.
+   Reports rollout wall time and the failed-request count (must be 0).
+
+Metrics are fed through ``scripts/perf_regress.py``: a
+``gateway_requests_per_sec`` candidate is synthesized from the live
+telemetry registry (``from_registry``) and gated — against the repo's
+``BENCH_*.json`` trajectories normally, or against a synthetic
+trajectory written from this very run in ``--smoke`` (where the gate
+must pass and both ISSUE 7 acceptance criteria are asserted: the
+chaos-kill backlog completes exactly once with solo-reference tokens,
+and the rolling update lands in every replica with zero failed
+requests).
+
+Usage:  PYTHONPATH=/root/repo python scripts/perf_gateway.py
+        [--smoke] [--replicas 3] [--policy least_loaded]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+if str(REPO / "scripts") not in sys.path:
+    sys.path.insert(0, str(REPO / "scripts"))
+
+import numpy as np
+
+import perf_regress
+
+
+def build_workload(args):
+    """Saturated backlog: prompt lengths and output budgets drawn so
+    every padded prompt + budget fits the single max_len envelope."""
+    rng = np.random.default_rng(args.seed)
+    a = args.prefill_align
+    work = []
+    while len(work) < args.requests:
+        t = int(rng.integers(args.prompt_lo, args.prompt_hi + 1))
+        n = int(rng.integers(args.new_lo, args.new_hi + 1))
+        if -(-t // a) * a + n <= args.max_len:
+            work.append({"prompt": rng.integers(
+                0, args.vocab, (t,)).astype(np.int32), "n_new": n})
+    return work
+
+
+def _percentiles(xs):
+    return (round(float(np.percentile(xs, 50)), 4),
+            round(float(np.percentile(xs, 95)), 4))
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import ModelSpec, model_config
+
+    spec = model_config(
+        "transformer_lm", (args.max_len,), input_dtype="int32",
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        max_len=args.max_len, dtype=args.dtype)
+    model = ModelSpec.from_config(spec).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return model, variables
+
+
+def _warmed_engine(model, variables, work, args):
+    """A DecodeEngine with every padded prompt length's prefill AND
+    the step program compiled (each engine owns its programs, so each
+    replica warms separately — excluded from all timed runs)."""
+    from distkeras_tpu.serving import DecodeEngine
+
+    eng = DecodeEngine(model, variables, slots=args.slots,
+                       prefill_align=args.prefill_align,
+                       max_new_tokens=args.new_hi)
+    a = args.prefill_align
+    lengths = sorted({-(-len(w["prompt"]) // a) * a for w in work})
+    list(eng.run([{"prompt": np.zeros((t,), np.int32),
+                   "max_new_tokens": 2} for t in lengths]))
+    return eng
+
+
+def run_ab_arm(model, variables, work, args, k):
+    """The backlog through a gateway over ``k`` warmed in-process
+    replicas; TTFT is queue-to-first (everything arrives at t0)."""
+    from distkeras_tpu.gateway import EngineReplica, ServingGateway
+
+    reps = [EngineReplica(_warmed_engine(model, variables, work, args),
+                          name=f"r{i}") for i in range(k)]
+    with ServingGateway(reps, policy=args.policy) as gw:
+        t0 = time.perf_counter()
+        rids = [gw.submit(w["prompt"], max_new_tokens=w["n_new"])
+                for w in work]
+        results = [gw.result(r, timeout=600) for r in rids]
+        wall = time.perf_counter() - t0
+    assert all(r.get("error") is None for r in results), results
+    goodput = sum(w["n_new"] for w in work)
+    p50, p95 = _percentiles([r["t_first"] - t0 for r in results])
+    return {"replicas": k, "wall_s": round(wall, 3),
+            "goodput_tok_s": round(goodput / wall, 1),
+            "queue_to_first_p50_s": p50,
+            "queue_to_first_p95_s": p95}, results
+
+
+def run_failover(model, variables, work, args):
+    """K socket replicas under targeted chaos; kill one mid-backlog.
+    Failover latency = kill → ``t_finish`` of each request that the
+    flight recorder shows failing over off the victim."""
+    from distkeras_tpu import flight_recorder
+    from distkeras_tpu.gateway import (EngineReplica, RemoteReplica,
+                                       ReplicaServer, ServingGateway)
+    from distkeras_tpu.parallel.faults import ChaosTransport
+
+    servers = [ReplicaServer(EngineReplica(
+        _warmed_engine(model, variables, work, args),
+        name=f"s{i}")).start() for i in range(args.replicas)]
+    ports = {s.address[1] for s in servers}
+    remotes = [RemoteReplica("127.0.0.1", s.address[1], name=f"s{i}")
+               for i, s in enumerate(servers)]
+    victim = 1 % len(servers)
+    try:
+        with ChaosTransport(seed=args.chaos_seed,
+                            reset_rate=args.reset_rate,
+                            max_injections=args.max_injections,
+                            skip_ops=2, target_ports=ports) as ct:
+            with ServingGateway(remotes, policy="round_robin",
+                                retries=8, backoff_base=0.01,
+                                seed=args.seed) as gw:
+                t0 = time.perf_counter()
+                rids = [gw.submit(w["prompt"],
+                                  max_new_tokens=w["n_new"])
+                        for w in work]
+                t_kill = time.perf_counter()
+                servers[victim].kill()
+                results = [gw.result(r, timeout=600) for r in rids]
+                wall = time.perf_counter() - t0
+        injected = ct.total_injected
+    finally:
+        for s in servers:
+            s.stop()
+    events = (flight_recorder.active().read_events()
+              if flight_recorder.active() else [])
+    failed_over = {e["request_id"] for e in events
+                   if e["kind"] == "failover"
+                   and e.get("replica") == f"s{victim}"}
+    by_rid = {r["request_id"]: r for r in results}
+    lat = [by_rid[rid]["t_finish"] - t_kill
+           for rid in failed_over if rid in by_rid
+           and by_rid[rid].get("t_finish", 0) > t_kill]
+    out = {"replicas": args.replicas, "victim": f"s{victim}",
+           "wall_s": round(wall, 3),
+           "chaos_injected": injected,
+           "requests_failed_over": len(failed_over),
+           "flight_replica_down": sum(
+               1 for e in events if e["kind"] == "replica_down"),
+           "flight_failover": sum(
+               1 for e in events if e["kind"] == "failover")}
+    if lat:
+        p50, p95 = _percentiles(lat)
+        out.update({"failover_p50_s": p50, "failover_p95_s": p95,
+                    "failover_max_s": round(max(lat), 4)})
+    return out, results, injected
+
+
+def run_rolling_update(model, variables, work, args):
+    """Live-PS rollout under traffic: a pump thread keeps requests
+    flowing while every replica is drained, swapped, and readmitted
+    one at a time.  Failed traffic must be zero."""
+    import jax
+
+    from distkeras_tpu.gateway import EngineReplica, ServingGateway
+    from distkeras_tpu.parallel.host_ps import HostParameterServer
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.7,
+                                        variables["params"])
+    ps = HostParameterServer(DownpourRule(), new_params)
+    reps = [EngineReplica(_warmed_engine(model, variables, work, args),
+                          name=f"r{i}") for i in range(args.replicas)]
+    stop = threading.Event()
+    traffic: list = []
+
+    def pump(gw):
+        k = 0
+        while not stop.is_set():
+            w = work[k % len(work)]
+            rid = gw.submit(w["prompt"], max_new_tokens=w["n_new"])
+            traffic.append(gw.result(rid, timeout=600))
+            k += 1
+
+    with ServingGateway(reps, policy="least_loaded", retries=6,
+                        backoff_base=0.005) as gw:
+        t = threading.Thread(target=pump, args=(gw,), daemon=True)
+        t.start()
+        try:
+            t0 = time.perf_counter()
+            report = gw.rolling_update(ps, quiesce_timeout=120)
+            wall = time.perf_counter() - t0
+        finally:
+            stop.set()
+            t.join(60)
+        post = [gw.result(gw.submit(w["prompt"],
+                                    max_new_tokens=w["n_new"]),
+                          timeout=600) for w in work[:2]]
+    failed = [r for r in traffic if r.get("error")]
+    return {"replicas": args.replicas, "rollout_wall_s": round(wall, 3),
+            "updated": report["updated"], "skipped": report["skipped"],
+            "rolled_back": report["rolled_back"],
+            "traffic_requests": len(traffic),
+            "traffic_failed": len(failed)}, new_params, post, reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shapes + the ISSUE 7 acceptance "
+                         "assertions (the tier-1 registration)")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--prompt-lo", type=int, default=16)
+    ap.add_argument("--prompt-hi", type=int, default=96)
+    ap.add_argument("--new-lo", type=int, default=8)
+    ap.add_argument("--new-hi", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prefill-align", type=int, default=16)
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="K for the K-replica arm / drills")
+    ap.add_argument("--policy", default="least_loaded",
+                    choices=["round_robin", "least_loaded", "session"])
+    ap.add_argument("--chaos-seed", type=int, default=11)
+    ap.add_argument("--reset-rate", type=float, default=0.15)
+    ap.add_argument("--max-injections", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None,
+                    help="artifact directory (flight recorder, "
+                         "registry snapshot, smoke gate trajectory)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="perf_regress gate slack")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.layers, args.d_model, args.heads = 1, 32, 2
+        args.vocab, args.max_len, args.dtype = 37, 32, "float32"
+        args.requests, args.prompt_lo, args.prompt_hi = 10, 3, 9
+        args.new_lo, args.new_hi = 3, 6
+        args.slots, args.prefill_align, args.replicas = 2, 4, 3
+
+    out_dir = pathlib.Path(args.out_dir
+                           or tempfile.mkdtemp(prefix="dkt_gw_"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    import jax
+
+    from distkeras_tpu import flight_recorder, telemetry
+    from distkeras_tpu.models import generate
+
+    tel = telemetry.enable()
+    flight_recorder.start(out_dir / "fdr")
+    model, variables = _build_model(args)
+    work = build_workload(args)
+    goodput = sum(w["n_new"] for w in work)
+
+    out = {"metric": "gateway_ab_failover_rollout",
+           "model": f"lm L{args.layers} d{args.d_model}",
+           "requests": args.requests, "policy": args.policy,
+           "goodput_tokens": int(goodput), "arms": {}}
+
+    t_run0 = time.perf_counter()
+    out["arms"]["solo"], _ = run_ab_arm(model, variables, work,
+                                        args, 1)
+    out["arms"]["gateway"], gw_results = run_ab_arm(
+        model, variables, work, args, args.replicas)
+    out["speedup_k_vs_1"] = round(
+        out["arms"]["gateway"]["goodput_tok_s"]
+        / out["arms"]["solo"]["goodput_tok_s"], 3)
+
+    out["failover"], fo_results, injected = run_failover(
+        model, variables, work, args)
+    out["rolling_update"], new_params, post, reps = \
+        run_rolling_update(model, variables, work, args)
+    run_seconds = time.perf_counter() - t_run0
+
+    snap_path = out_dir / "registry.json"
+    snap_path.write_text(json.dumps(tel.metrics.snapshot(),
+                                    default=repr))
+    flight_recorder.stop()
+    telemetry.disable()
+
+    # ---- the perf_regress hookup: registry counter -> rate candidate
+    cands = perf_regress.from_registry(
+        str(snap_path), "gateway_requests_per_sec",
+        "gateway_requests_total", run_seconds)
+    cands.append({"metric": "gateway_goodput_tok_s",
+                  "value": out["arms"]["gateway"]["goodput_tok_s"]})
+    if args.smoke:
+        # synthetic trajectory from this very run — the gate must pass
+        for i, c in enumerate(cands):
+            for n in (1, 2, 3):
+                (out_dir / f"BENCH_c{i}_r{n:02d}.json").write_text(
+                    json.dumps({
+                        "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                        "parsed": {"metric": c["metric"],
+                                   "value": c["value"] * (1 + 0.02 * n),
+                                   "unit": "per_sec"}}))
+        baselines = str(out_dir / "BENCH_*.json")
+    else:
+        baselines = perf_regress.DEFAULT_BASELINES
+    rows = perf_regress.evaluate(
+        cands, perf_regress.load_trajectories(baselines),
+        tolerance=0.5 if args.smoke else args.tolerance)
+    print(perf_regress.render(rows))
+    out["gate"] = [{k: r[k] for k in ("metric", "value", "status")}
+                   for r in rows]
+
+    if args.smoke:
+        # acceptance 1: chaos kill — exactly once, solo-parity tokens
+        assert injected > 0
+        assert [r.get("error") for r in fo_results] == \
+            [None] * len(work)
+        assert len({r["request_id"] for r in fo_results}) == len(work)
+        for res_set in (gw_results, fo_results):
+            for w, r in zip(work, res_set):
+                want = np.asarray(generate(
+                    model, variables, w["prompt"][None, :],
+                    max_new_tokens=w["n_new"]))[0, len(w["prompt"]):]
+                np.testing.assert_array_equal(np.asarray(r["tokens"]),
+                                              want)
+        assert out["failover"]["flight_replica_down"] > 0
+        assert out["failover"]["flight_failover"] > 0
+        # acceptance 2: rolling update landed everywhere, zero failed
+        ru = out["rolling_update"]
+        assert ru["updated"] == [f"r{i}" for i in range(args.replicas)]
+        assert not ru["rolled_back"] and not ru["skipped"]
+        assert ru["traffic_failed"] == 0
+        new_vars = dict(variables)
+        new_vars["params"] = new_params
+        for rep in reps:
+            got = jax.tree_util.tree_leaves(rep.variables()["params"])
+            for g, ww in zip(got,
+                             jax.tree_util.tree_leaves(new_params)):
+                np.testing.assert_allclose(np.asarray(g),
+                                           np.asarray(ww))
+        for w, r in zip(work[:2], post):
+            want = np.asarray(generate(
+                model, new_vars, w["prompt"][None, :],
+                max_new_tokens=w["n_new"]))[0, len(w["prompt"]):]
+            np.testing.assert_array_equal(np.asarray(r["tokens"]),
+                                          want)
+        # the gate passed on this run's own trajectory
+        assert all(r["status"] == "pass" for r in rows), rows
+        out["smoke"] = "ok"
+    print(json.dumps(out, default=repr))
+
+
+if __name__ == "__main__":
+    main()

@@ -327,3 +327,34 @@ def test_adag_tensor_parallel_kill_and_resume_bitwise(tmp_path):
                     _leaves(resumed.trained_variables)):
         np.testing.assert_array_equal(a, b)
     assert resumed.history["round_loss"] == ref.history["round_loss"]
+
+
+def test_ps_snapshot_center_resolves_file_and_dict(tmp_path):
+    """ISSUE 7 satellite: ``ps_snapshot_center`` lifts just the center
+    tree out of a PS snapshot (file or dict) — the serving gateway's
+    rolling-update source — for both the unsharded and sharded
+    formats, and rejects non-snapshot payloads."""
+    from distkeras_tpu.checkpoint import (ps_snapshot_center,
+                                          save_ps_snapshot)
+    from distkeras_tpu.parallel.host_ps import HostParameterServer
+    from distkeras_tpu.parallel.sharded_ps import (
+        ShardedParameterServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    center = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+              "b": np.zeros((3,), np.float32)}
+
+    snap = HostParameterServer(DownpourRule(), center).snapshot()
+    path = save_ps_snapshot(tmp_path / "ps.msgpack", snap)
+    for got in (ps_snapshot_center(path), ps_snapshot_center(snap)):
+        assert set(got) == {"w", "b"}
+        np.testing.assert_array_equal(got["w"], center["w"])
+
+    sharded = ShardedParameterServer(DownpourRule(), center,
+                                     num_shards=2).snapshot()
+    spath = save_ps_snapshot(tmp_path / "sps.msgpack", sharded)
+    got = ps_snapshot_center(spath)
+    np.testing.assert_array_equal(got["w"], center["w"])
+
+    with pytest.raises(ValueError, match="no 'center' key"):
+        ps_snapshot_center({"state": 1})

@@ -26,6 +26,7 @@ SCRIPTS = REPO / "scripts"
 SMOKE_SCRIPTS = {
     "chaos_report.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
+    "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
     "perf_regress.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],

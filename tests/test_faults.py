@@ -249,3 +249,47 @@ def test_uninstall_is_idempotent_and_stack_safe():
     a._installed = True
     a.uninstall()
     assert bindings() == orig
+
+
+def test_target_ports_scopes_firing_but_not_the_schedule():
+    """ISSUE 7 satellite: ``target_ports`` restricts which hops a
+    fault can FIRE on — the serving gateway's replica wire vs the PS
+    exchange in one process — while the rng is still consumed on
+    every op, so the schedule stays a pure function of (seed, op
+    index) regardless of what traffic interleaves."""
+    # 1) a non-targeted peer is never faulted, even at rate 1.0
+    #    (socketpair peers have no TCP port -> unattributable -> safe)
+    with ChaosTransport(seed=0, reset_rate=1.0,
+                        target_ports={9999}) as ct:
+        a, b = socket.socketpair()
+        transport.send_msg(a, b"payload")
+        assert transport.recv_msg(b) == b"payload"
+        a.close()
+        b.close()
+    assert ct.total_injected == 0
+
+    # 2) the targeted port DOES fire
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen()
+    port = srv.getsockname()[1]
+    try:
+        with ChaosTransport(seed=0, reset_rate=1.0, max_injections=1,
+                            target_ports={port}) as ct:
+            sock = socket.create_connection(("127.0.0.1", port))
+            with pytest.raises(ConnectionResetError, match="chaos"):
+                transport.send_msg(sock, b"x")
+        assert ct.counts["reset"] == 1
+    finally:
+        srv.close()
+
+    # 3) schedule purity: the k-th op draws the same decision whether
+    #    or not non-targeted ops were interleaved and filtered out
+    ref = ChaosTransport(seed=7, reset_rate=0.3)
+    want = [ref._draw("send", port=1234) for _ in range(60)]
+    mixed = ChaosTransport(seed=7, reset_rate=0.3,
+                           target_ports={1234})
+    got = [mixed._draw("send", port=1234 if k % 2 == 0 else 5678)
+           for k in range(60)]
+    assert all(g is None for g in got[1::2])  # off-target never fires
+    assert got[0::2] == want[0::2]  # same stream at the same indices

@@ -1,0 +1,498 @@
+"""Serving gateway (``distkeras_tpu.gateway``): routing policies,
+failover, exactly-once delivery under chaos, and rolling weight
+updates from the PS — the ISSUE 7 acceptance scenarios.
+
+The correctness bar everywhere is the engine's own: a request routed
+through the gateway (in-process or over the socket arm, through
+kills and retries) must produce the same greedy tokens as a solo
+``DecodeEngine`` run, exactly once."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.gateway import (EngineReplica, RemoteReplica,
+                                   ReplicaDown, ReplicaServer,
+                                   ServingGateway)
+from distkeras_tpu.models import ModelSpec, generate, model_config
+from distkeras_tpu.parallel.faults import ChaosTransport
+from distkeras_tpu.parallel.host_ps import HostParameterServer
+from distkeras_tpu.parallel.update_rules import DownpourRule
+from distkeras_tpu.serving import DecodeEngine
+
+jax.config.update("jax_platforms", "cpu")
+
+MAXLEN, VOCAB = 32, 37
+
+
+def _model():
+    spec = model_config("transformer_lm", (MAXLEN,),
+                        input_dtype="int32", vocab_size=VOCAB,
+                        num_layers=1, d_model=32, num_heads=2,
+                        max_len=MAXLEN, dtype="float32")
+    model = ModelSpec.from_config(spec).build()
+    variables = model.init(jax.random.key(0),
+                           np.zeros((2, MAXLEN), np.int32))
+    return model, variables
+
+
+def _prompts(lengths, seed=3):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, (t,)).astype(np.int32)
+            for t in lengths]
+
+
+def _want(model, variables, prompt, n_new):
+    return np.asarray(generate(model, variables, prompt[None, :],
+                               max_new_tokens=n_new))[0, len(prompt):]
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_align", 4)
+    kw.setdefault("max_new_tokens", 5)
+    return DecodeEngine(model, variables, **kw)
+
+
+@pytest.fixture
+def flight(tmp_path):
+    fr = flight_recorder.start(tmp_path / "fdr")
+    yield fr
+    flight_recorder.stop()
+
+
+# ---- routing (stub replicas: policy logic, not decode) ----------------
+
+
+class _FakeReplica:
+    def __init__(self, name, load=0, alive=True, fail_first=0):
+        self.name = name
+        self._load = load
+        self.alive = alive
+        self.fail_first = fail_first
+        self.dispatched: list = []
+
+    def start(self):
+        return self
+
+    def load(self):
+        return self._load
+
+    def dispatch(self, spec, on_result):
+        self.dispatched.append(spec["request_id"])
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise ReplicaDown(f"{self.name} injected failure")
+        on_result({"request_id": spec["request_id"],
+                   "prompt": spec["prompt"],
+                   "tokens": np.asarray([1], np.int32)})
+
+    def health(self):
+        return {"alive": self.alive, "state": "ok",
+                "load": self._load}
+
+
+def test_round_robin_spreads_evenly():
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    with ServingGateway(reps, policy="round_robin") as gw:
+        for r in [gw.submit([1, 2]) for _ in range(9)]:
+            gw.result(r, timeout=5)
+    assert [len(r.dispatched) for r in reps] == [3, 3, 3]
+
+
+def test_least_loaded_prefers_the_idle_replica():
+    reps = [_FakeReplica("a", load=5), _FakeReplica("b", load=0),
+            _FakeReplica("c", load=3)]
+    with ServingGateway(reps, policy="least_loaded") as gw:
+        for r in [gw.submit([1, 2]) for _ in range(6)]:
+            gw.result(r, timeout=5)
+    assert len(reps[1].dispatched) == 6  # fake loads never change
+
+
+def test_session_affinity_is_sticky_and_spreads_keys():
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    with ServingGateway(reps, policy="session") as gw:
+        for key in ("alpha", "beta", "gamma", "delta"):
+            for _ in range(4):
+                gw.result(gw.submit([1], session=key), timeout=5)
+    # every key landed on exactly one replica...
+    total = 0
+    for r in reps:
+        total += len(r.dispatched)
+        assert len(r.dispatched) % 4 == 0  # whole keys, never split
+    assert total == 16
+    # ...and the keys did not all collapse onto one replica
+    assert sum(1 for r in reps if r.dispatched) >= 2, (
+        [len(r.dispatched) for r in reps])
+
+
+def test_failover_routes_around_a_failing_replica():
+    reps = [_FakeReplica("a", fail_first=10), _FakeReplica("b")]
+    with ServingGateway(reps, policy="round_robin", retries=3,
+                        backoff_base=0.001) as gw:
+        res = gw.result(gw.submit([1, 2]), timeout=5)
+    assert "error" not in res
+    assert reps[1].dispatched  # completed on the healthy replica
+
+
+def test_retries_exhausted_yields_an_error_result_not_a_hang():
+    reps = [_FakeReplica("a", fail_first=10 ** 6),
+            _FakeReplica("b", fail_first=10 ** 6)]
+    with ServingGateway(reps, retries=2, backoff_base=0.001) as gw:
+        res = gw.result(gw.submit([1]), timeout=10)
+    assert res["error"].startswith("gateway_retries_exhausted")
+    assert res["attempts"] == 3  # initial + 2 retries
+
+
+def test_duplicate_completion_is_delivered_exactly_once():
+    class _Dup(_FakeReplica):
+        def dispatch(self, spec, on_result):
+            self.dispatched.append(spec["request_id"])
+            res = {"request_id": spec["request_id"],
+                   "prompt": spec["prompt"],
+                   "tokens": np.asarray([1], np.int32)}
+            on_result(dict(res))
+            on_result({**res, "tokens": np.asarray([9], np.int32)})
+
+    with ServingGateway([_Dup("a")]) as gw:
+        rid = gw.submit([1])
+        res = gw.result(rid, timeout=5)
+        np.testing.assert_array_equal(res["tokens"], [1])  # first won
+        with pytest.raises(KeyError):
+            gw.result(rid)  # consumed: delivered exactly once
+
+
+def test_healthz_aggregates_per_replica_verdicts():
+    reps = [_FakeReplica("a"), _FakeReplica("b"), _FakeReplica("c")]
+    gw = ServingGateway(reps)
+    h = gw.healthz()
+    assert h["state"] == "ok" and h["alive"] == 3
+    assert set(h["replicas"]) == {"a", "b", "c"}
+    reps[1].alive = False
+    h = gw.healthz()
+    assert h["state"] == "degraded" and h["alive"] == 2
+    for r in reps:
+        r.alive = False
+    assert gw.healthz()["state"] == "critical"
+
+
+# ---- in-process replicas: correctness through the gateway -------------
+
+
+def test_gateway_results_match_solo_engine_per_request():
+    """Routing over K replicas is invisible in the tokens: every
+    request matches its solo generate() reference, under both the
+    ordered and as-completed iteration modes."""
+    model, variables = _model()
+    prompts = _prompts([5, 9, 3, 7, 5, 11, 4, 6])
+    reqs = [{"prompt": p, "max_new_tokens": n, "i": i}
+            for i, (p, n) in enumerate(
+                zip(prompts, [4, 7, 3, 6, 5, 8, 2, 7]))]
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(2)]
+    with ServingGateway(reps, policy="least_loaded") as gw:
+        out = {r["i"]: r for r in gw.run(reqs, ordered=False)}
+    assert len(out) == 8
+    for req in reqs:
+        assert "error" not in out[req["i"]]
+        np.testing.assert_array_equal(
+            out[req["i"]]["tokens"],
+            _want(model, variables, req["prompt"],
+                  req["max_new_tokens"]))
+
+
+def test_killed_replica_requests_complete_elsewhere(flight):
+    """ISSUE 7 acceptance (in-process arm): kill one of K=3 replicas
+    with requests in flight — every request still completes exactly
+    once with correct tokens, and the flight recorder tells the story
+    (``replica_down`` precedes the ``failover``s it caused)."""
+    model, variables = _model()
+    prompts = _prompts([5, 7, 3, 6, 4, 8, 5, 6, 7, 4, 5, 6], seed=2)
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(3)]
+    with ServingGateway(reps, policy="round_robin", retries=6,
+                        backoff_base=0.005, seed=7) as gw:
+        rids = [gw.submit(p) for p in prompts]
+        reps[1].kill()  # mid-stream: ~1/3 of the requests are its
+        results = [gw.result(r, timeout=60) for r in rids]
+    assert [r.get("error") for r in results] == [None] * len(prompts)
+    assert len({r["request_id"] for r in results}) == len(prompts)
+    for p, r in zip(prompts, results):
+        np.testing.assert_array_equal(
+            r["tokens"], _want(model, variables, p, 5))
+    events = flight.read_events()
+    downs = [i for i, e in enumerate(events)
+             if e["kind"] == "replica_down"]
+    overs = [i for i, e in enumerate(events)
+             if e["kind"] == "failover"]
+    assert downs and overs, [e["kind"] for e in events]
+    assert min(downs) < min(overs)  # the story reads in order
+    assert all(e["replica"] == "r1" for e in events
+               if e["kind"] == "replica_down")
+
+
+def test_stopping_a_replica_loses_nothing():
+    """Graceful maintenance stop: in-flight requests come back as
+    ``engine_closed`` from the closing engine and the gateway reroutes
+    them — the caller never sees the stop."""
+    model, variables = _model()
+    prompts = _prompts([5, 6, 4, 7, 5, 6], seed=4)
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(2)]
+    with ServingGateway(reps, retries=6, backoff_base=0.005) as gw:
+        rids = [gw.submit(p) for p in prompts]
+        reps[0].stop()
+        results = [gw.result(r, timeout=60) for r in rids]
+    assert [r.get("error") for r in results] == [None] * len(prompts)
+    for p, r in zip(prompts, results):
+        np.testing.assert_array_equal(
+            r["tokens"], _want(model, variables, p, 5))
+
+
+# ---- the socket arm under chaos ---------------------------------------
+
+
+def test_socket_chaos_kill_completes_exactly_once(flight):
+    """THE acceptance sweep: K=3 socket replicas, seeded chaos on the
+    gateway→replica hop (``target_ports`` keeps the schedule pure but
+    scoped), one replica killed mid-stream.  Every request completes
+    exactly once with tokens equal to the solo reference; the flight
+    recorder carries the ``replica_down`` → ``failover`` story."""
+    model, variables = _model()
+    prompts = _prompts([5, 7, 3, 6, 4, 8, 5, 6, 7, 4], seed=5)
+    servers = [ReplicaServer(EngineReplica(
+        _engine(model, variables), name=f"s{i}")).start()
+        for i in range(3)]
+    ports = {s.address[1] for s in servers}
+    remotes = [RemoteReplica("127.0.0.1", s.address[1], name=f"s{i}")
+               for i, s in enumerate(servers)]
+    try:
+        with ChaosTransport(seed=11, reset_rate=0.15,
+                            max_injections=4, skip_ops=2,
+                            target_ports=ports) as ct:
+            with ServingGateway(remotes, policy="round_robin",
+                                retries=8, backoff_base=0.01,
+                                seed=3) as gw:
+                rids = [gw.submit(p) for p in prompts]
+                servers[1].kill()
+                results = [gw.result(r, timeout=120) for r in rids]
+        assert ct.total_injected > 0  # the chaos really fired
+        assert [r.get("error") for r in results] == \
+            [None] * len(prompts)
+        assert len({r["request_id"] for r in results}) == len(prompts)
+        for p, r in zip(prompts, results):
+            np.testing.assert_array_equal(
+                np.asarray(r["tokens"]),
+                _want(model, variables, p, 5))
+        kinds = [e["kind"] for e in flight.read_events()]
+        assert "replica_down" in kinds and "failover" in kinds
+        assert kinds.index("replica_down") < \
+            len(kinds) - 1 - kinds[::-1].index("failover")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_remote_probe_revives_a_down_marked_replica():
+    model, variables = _model()
+    server = ReplicaServer(EngineReplica(
+        _engine(model, variables), name="s0")).start()
+    remote = RemoteReplica("127.0.0.1", server.address[1], name="s0")
+    try:
+        remote._mark_down(ConnectionError("test"))
+        assert not remote.alive
+        assert remote.probe() and remote.alive
+        h = remote.health()
+        assert h["alive"] and h["state"] in ("ok", "degraded")
+    finally:
+        server.stop()
+
+
+# ---- rolling weight updates -------------------------------------------
+
+
+def test_rolling_update_from_live_ps_zero_failed_requests(flight):
+    """ISSUE 7 acceptance: a rolling update sourced from a LIVE
+    ``HostParameterServer`` swaps the PS center into every replica —
+    one at a time, zero failed requests under concurrent traffic —
+    and post-rollout tokens match an engine built on the new
+    weights."""
+    model, variables = _model()
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.7,
+                                        variables["params"])
+    ps = HostParameterServer(DownpourRule(), new_params)
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(3)]
+    prompts = _prompts([5, 7, 4, 6], seed=8)
+    stop = threading.Event()
+    traffic: list = []
+
+    def pump(gw):
+        k = 0
+        while not stop.is_set():
+            rid = gw.submit(prompts[k % len(prompts)])
+            traffic.append(gw.result(rid, timeout=60))
+            k += 1
+
+    with ServingGateway(reps, policy="least_loaded", retries=6,
+                        backoff_base=0.005) as gw:
+        t = threading.Thread(target=pump, args=(gw,), daemon=True)
+        t.start()
+        try:
+            report = gw.rolling_update(ps, quiesce_timeout=60)
+        finally:
+            stop.set()
+            t.join(30)
+        assert report["updated"] == ["r0", "r1", "r2"]
+        assert not report["rolled_back"] and not report["skipped"]
+        # every replica now serves the PS center
+        for rep in reps:
+            got = jax.tree_util.tree_leaves(
+                rep.variables()["params"])
+            want = jax.tree_util.tree_leaves(new_params)
+            for g, w in zip(got, want):
+                np.testing.assert_allclose(np.asarray(g),
+                                           np.asarray(w))
+        post = [gw.result(gw.submit(p), timeout=60) for p in prompts]
+    assert traffic, "no concurrent traffic was served"
+    assert all(r.get("error") is None for r in traffic), (
+        [r.get("error") for r in traffic if r.get("error")])
+    new_vars = {"params": new_params}
+    for p, r in zip(prompts, post):
+        np.testing.assert_array_equal(
+            r["tokens"], _want(model, new_vars, p, 5))
+    swaps = [e for e in flight.read_events()
+             if e["kind"] == "weight_swap" and "replica" in e]
+    assert [e["replica"] for e in swaps] == ["r0", "r1", "r2"]
+
+
+def test_rolling_update_from_snapshot_file(tmp_path):
+    """The offline source: ``checkpoint.ps_snapshot_center`` connects
+    a PS snapshot file to the serving fleet."""
+    from distkeras_tpu import checkpoint
+
+    model, variables = _model()
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.5,
+                                        variables["params"])
+    path = checkpoint.save_ps_snapshot(
+        tmp_path / "snap.msgpack",
+        HostParameterServer(DownpourRule(), new_params).snapshot())
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(2)]
+    (p,) = _prompts([6], seed=9)
+    with ServingGateway(reps) as gw:
+        report = gw.rolling_update(str(path))
+        assert report["updated"] == ["r0", "r1"]
+        res = gw.result(gw.submit(p), timeout=60)
+    np.testing.assert_array_equal(
+        res["tokens"], _want(model, {"params": new_params}, p, 5))
+
+
+def test_rolling_update_rolls_back_on_critical_health(flight):
+    """A rollout that drives a replica ``critical`` is undone: every
+    already-updated replica returns to the pre-rollout weights, and
+    the flight recorder carries the ``rollback`` event."""
+    model, variables = _model()
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.9,
+                                        variables["params"])
+    reps = [EngineReplica(_engine(model, variables), name=f"r{i}")
+            for i in range(2)]
+    verdicts = iter([{"state": "ok"}, {"state": "critical"}])
+    with ServingGateway(reps) as gw:
+        report = gw.rolling_update(
+            {"params": new_params},
+            health_check=lambda rep: next(verdicts))
+        assert report["rolled_back"]
+        assert report["updated"] == ["r0"]  # r1's check failed
+        old = jax.tree_util.tree_leaves(variables["params"])
+        for rep in reps:
+            got = jax.tree_util.tree_leaves(
+                rep.variables()["params"])
+            for g, w in zip(got, old):
+                np.testing.assert_allclose(np.asarray(g),
+                                           np.asarray(w))
+    kinds = [e["kind"] for e in flight.read_events()]
+    assert "rollback" in kinds
+
+
+def test_rolling_update_over_the_socket_arm():
+    """Remote replicas swap through the wire (``b"w"``/``b"v"``/
+    ``b"q"`` ops) — the rollout machinery is arm-agnostic."""
+    model, variables = _model()
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.6,
+                                        variables["params"])
+    servers = [ReplicaServer(EngineReplica(
+        _engine(model, variables), name=f"s{i}")).start()
+        for i in range(2)]
+    remotes = [RemoteReplica("127.0.0.1", s.address[1], name=f"s{i}")
+               for i, s in enumerate(servers)]
+    (p,) = _prompts([5], seed=10)
+    try:
+        with ServingGateway(remotes) as gw:
+            report = gw.rolling_update({"params": new_params})
+            assert report["updated"] == ["s0", "s1"]
+            res = gw.result(gw.submit(p), timeout=60)
+        np.testing.assert_array_equal(
+            np.asarray(res["tokens"]),
+            _want(model, {"params": new_params}, p, 5))
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---- engine-level swap contract ---------------------------------------
+
+
+def test_swap_variables_no_recompile_and_mismatch_rejected():
+    """``swap_variables`` reuses every compiled program (same
+    ``compile_counts`` before/after — the hot-swap claim) and rejects
+    a tree that would retrace: wrong structure, shape, or dtype."""
+    model, variables = _model()
+    eng = _engine(model, variables)
+    (p,) = _prompts([6], seed=12)
+    first = next(iter(eng.run([p])))
+    np.testing.assert_array_equal(first["tokens"],
+                                  _want(model, variables, p, 5))
+    counts = dict(eng.compile_counts)
+    new_vars = jax.tree_util.tree_map(lambda x: x * 0.8,
+                                      dict(variables))
+    eng.swap_variables(new_vars)
+    swapped = next(iter(eng.run([p])))
+    np.testing.assert_array_equal(swapped["tokens"],
+                                  _want(model, new_vars, p, 5))
+    assert dict(eng.compile_counts) == counts  # zero new programs
+
+    leaves, treedef = jax.tree_util.tree_flatten(new_vars)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        eng.swap_variables({"params": {"nope": leaves[0]}})
+    bad_shape = jax.tree_util.tree_unflatten(
+        treedef, [np.zeros(np.shape(x) + (1,), np.float32)
+                  for x in leaves])
+    with pytest.raises(ValueError, match="leaf 0 mismatch"):
+        eng.swap_variables(bad_shape)
+    bad_dtype = jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(x, np.float64) for x in leaves])
+    with pytest.raises(ValueError, match="mismatch"):
+        eng.swap_variables(bad_dtype)
+    eng.close()
+
+
+def test_failover_rate_signal_reaches_the_watchdog():
+    """``gateway_failovers_total / gateway_requests_total`` is a
+    first-class SLO signal: a failover storm flips the watchdog."""
+    tel = telemetry.enable()
+    try:
+        m = telemetry.metrics()
+        m.counter("gateway_requests_total", replica="a",
+                  policy="round_robin").inc(10)
+        m.counter("gateway_failovers_total", replica="a").inc(6)
+        w = telemetry.SLOWatchdog(m)
+        sig = w.signals()
+        assert sig["failover_rate"] == pytest.approx(0.6)
+        assert w.evaluate()["state"] == "critical"
+    finally:
+        telemetry.disable()

@@ -472,3 +472,95 @@ def test_cache_envelope_bounds_chunk_and_positions():
     with pytest.raises(ValueError, match="exceeds the cache size"):
         dec.apply({"params": variables["params"]},
                   jnp.zeros((1, 20), jnp.int32), mutable=["cache"])
+
+
+def test_submit_is_thread_safe_against_a_concurrent_stepper():
+    """ISSUE 7 satellite: ``submit()`` from many threads while another
+    thread steps the engine — the gateway's EngineReplica pattern.
+    Every request is admitted exactly once and its tokens match the
+    solo reference (the admission lock race this pins: queue/rid/
+    dedupe mutations vs the stepping thread's admission pops)."""
+    import threading
+    import time
+
+    model, variables = _model()
+    eng = DecodeEngine(model, variables, slots=3, prefill_align=4,
+                       max_new_tokens=4)
+    prompts = _prompts([5, 7, 4, 6, 5, 3, 6, 5], seed=31)
+    n_threads, per_thread = 4, 6
+    results: dict = {}
+    done_submitting = threading.Event()
+    errors: list = []
+
+    def stepper():
+        while not done_submitting.is_set() or eng.has_work():
+            for r in eng.step():
+                assert r["request_id"] not in results  # exactly once
+                results[r["request_id"]] = r
+            time.sleep(0.001)
+
+    def submitter(t):
+        try:
+            for j in range(per_thread):
+                eng.submit(prompts[(t * per_thread + j) % len(prompts)],
+                           request_id=f"t{t}-{j}")
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    step_thread = threading.Thread(target=stepper, daemon=True)
+    step_thread.start()
+    subs = [threading.Thread(target=submitter, args=(t,), daemon=True)
+            for t in range(n_threads)]
+    for s in subs:
+        s.start()
+    for s in subs:
+        s.join(30)
+    done_submitting.set()
+    step_thread.join(60)
+    assert not errors, errors
+    assert len(results) == n_threads * per_thread
+    for rid, r in results.items():
+        t, j = (int(x) for x in rid[1:].split("-"))
+        p = prompts[(t * per_thread + j) % len(prompts)]
+        np.testing.assert_array_equal(r["tokens"],
+                                      _want(model, variables, p, 4))
+    eng.close()
+
+
+def test_run_under_queue_bound_delivers_every_result():
+    """ISSUE 7 satellite: ``run()`` over a queue_bound engine treats
+    mid-iterable sheds as backpressure — completed results are
+    delivered (never discarded), one result per item, in order."""
+    model, variables = _model()
+    prompts = _prompts([5, 7, 5, 6, 5, 4, 6, 5, 7, 5], seed=37)
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=4, queue_bound=1)
+    out = list(eng.run([{"prompt": p, "i": i}
+                        for i, p in enumerate(prompts)]))
+    assert [r["i"] for r in out] == list(range(len(prompts)))
+    for r in out:
+        assert "error" not in r
+        np.testing.assert_array_equal(
+            r["tokens"], _want(model, variables, prompts[r["i"]], 4))
+    eng.close()
+
+
+def test_run_under_queue_bound_delivers_error_rows_too():
+    """Deadline casualties under shed backpressure come back as
+    ``error`` rows through ``run()`` — the whole iterable is accounted
+    for even when nothing survives."""
+    model, variables = _model()
+    prompts = _prompts([5, 6, 5, 7, 5, 6], seed=41)
+    eng = DecodeEngine(model, variables, slots=2, prefill_align=4,
+                       max_new_tokens=4, queue_bound=1,
+                       deadline=1e-4)
+    out = list(eng.run([{"prompt": p, "i": i}
+                        for i, p in enumerate(prompts)]))
+    assert [r["i"] for r in out] == list(range(len(prompts)))
+    assert any(r.get("error") == "deadline_exceeded" for r in out)
+    for r in out:
+        if r.get("error") is None:
+            np.testing.assert_array_equal(
+                r["tokens"],
+                _want(model, variables, prompts[r["i"]], 4))
+    eng.close()
