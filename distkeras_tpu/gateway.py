@@ -74,7 +74,7 @@ from distkeras_tpu.serving import ShedError
 
 _UNSET = object()
 
-POLICIES = ("round_robin", "least_loaded", "session")
+POLICIES = ("round_robin", "least_loaded", "session", "prefix")
 
 
 class ReplicaDown(ConnectionError):
@@ -710,7 +710,16 @@ class ServingGateway:
         anything duck-typing their surface).  Names must be unique.
       policy: ``round_robin`` | ``least_loaded`` | ``session`` (sticky
         by the ``session=`` key passed to ``submit``; requests without
-        a session key fall back to round-robin).
+        a session key fall back to round-robin) | ``prefix`` (sticky
+        by the first ``prefix_block`` prompt tokens, so requests that
+        share a system prompt land on the replica whose prefix cache
+        is warm — the RadixAttention affinity idea at gateway level;
+        composes with failover: a dead replica's key range just hashes
+        over the survivors).
+      prefix_block: prompt-head length (tokens) hashed by the
+        ``prefix`` policy; align it with the engines'
+        ``prefill_align`` so requests that share a cacheable prefix
+        share a replica.
       retries: failed attempts per request beyond the first before the
         request is completed as ``error="gateway_retries_exhausted"``.
       backoff_base/backoff_max/jitter/seed: full-jitter exponential
@@ -734,7 +743,8 @@ class ServingGateway:
                  policy: str = "round_robin", retries: int = 3,
                  backoff_base: float = 0.02, backoff_max: float = 0.5,
                  jitter: float = 0.5, seed: int = 0,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 prefix_block: int = 128):
         self._replicas = list(replicas)
         if not self._replicas:
             raise ValueError("ServingGateway needs >= 1 replica")
@@ -748,7 +758,11 @@ class ServingGateway:
             raise ValueError(f"retries must be >= 0; got {retries}")
         if not 0.0 <= jitter <= 1.0:
             raise ValueError(f"jitter={jitter} outside [0, 1]")
+        if prefix_block < 1:
+            raise ValueError(
+                f"prefix_block must be >= 1; got {prefix_block}")
         self.policy = policy
+        self.prefix_block = int(prefix_block)
         self.retries = int(retries)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
@@ -919,6 +933,14 @@ class ServingGateway:
                     and req.spec.get("session") is not None):
                 cands = sorted(cands, key=lambda r: r.name)
                 key = str(req.spec["session"]).encode()
+                return cands[zlib.crc32(key) % len(cands)]
+            if self.policy == "prefix":
+                # deterministic over the SORTED candidate set, same
+                # as session stickiness: equal prompt heads map to
+                # the same replica as long as the replica set is
+                # stable, and rehash consistently when it shrinks
+                cands = sorted(cands, key=lambda r: r.name)
+                key = req.spec["prompt"][:self.prefix_block].tobytes()
                 return cands[zlib.crc32(key) % len(cands)]
             rep = cands[self._rr % len(cands)]
             self._rr += 1

@@ -49,14 +49,45 @@ killing ``step()`` for its slot neighbors; ``drain()`` finishes the
 backlog and ``close()`` cancels what remains (every in-flight id comes
 back, ``error="engine_closed"``) and releases the device pools.
 
+Prefill reuse + scheduling (ISSUE 8, the two standard fixes for the
+remaining hot-path waste):
+
+* ``prefix_cache_bytes`` turns on a SHARED-PREFIX KV CACHE — a
+  host-side longest-prefix trie over token ids at ``prefill_align``
+  granularity (SGLang's RadixAttention idea, Zheng et al. 2024) whose
+  nodes hold ref-counted DEVICE segments (``[1, KVH, align, D]`` per
+  cache leaf, envelope-free so one store serves every bucket).  On
+  admit, the longest cached prefix is copied device-to-device into
+  the slot (``dynamic_update_slice``, zero model FLOPs) and only the
+  uncached tail is prefilled; finished requests donate their aligned
+  prompt blocks back, LRU-evicted beyond the byte budget with live
+  refs pinned.  ``swap_variables`` INVALIDATES the store — cached KV
+  under new weights is silently wrong.
+* ``prefill_chunk`` turns on CHUNKED PREFILL (Sarathi-Serve, Agrawal
+  et al. 2024): prompts prefill as a sequence of chunk-sized compiled
+  programs appended into the slot cache, at most one chunk per pool
+  per ``step()``, so a max-length prompt costs its live neighbors one
+  chunk quantum per token instead of freezing them for the whole
+  prefill.  Deadlines are re-checked between chunks.
+
+Both levers preserve greedy parity bit-for-bit (prefix rows are
+position-causal, the chunk path runs the exact dense cache read) and
+keep the compiled program set bounded; with both off, the legacy
+one-shot prefill path is byte-identical to before.
+
 Observability (``distkeras_tpu.telemetry``; no-op until
 ``telemetry.enable()``): per-bucket ``serving_queue_depth`` /
 ``serving_slot_occupancy`` gauges, ``serving_ttft_seconds`` /
 ``serving_latency_seconds`` histograms, token/request/finish counters,
 trace-time ``compiles_total{kind,bucket[,padded]}`` (the public face
 of ``compile_counts``), and ``prefill``/``decode_step`` spans +
-``evict`` instants on the serving thread's timeline track.  Request
-timing stamps all read ``telemetry.now()`` — see ``_finish``.
+``evict`` instants on the serving thread's timeline track.  The
+prefix/chunk layer adds ``serving_prefix_{hits,misses,evictions,
+invalidations}_total``, ``serving_prefill_tokens_saved_total``, the
+``serving_prefix_hit_rate`` gauge (an SLO watchdog signal),
+``prefix_copy``/``prefill_chunk`` spans, and a ``prefix_invalidate``
+flight-recorder event on every store invalidation.  Request timing
+stamps all read ``telemetry.now()`` — see ``_finish``.
 """
 
 from __future__ import annotations
@@ -95,7 +126,8 @@ def _ceil_to(n: int, align: int) -> int:
 
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "meta",
-                 "submit_order", "t_submit", "t_first", "deadline")
+                 "submit_order", "t_submit", "t_first", "deadline",
+                 "prefix_path", "weights_ver")
 
     def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order,
                  deadline=None):
@@ -111,13 +143,127 @@ class _Request:
         # absolute telemetry.now() expiry (None: no deadline)
         self.deadline = (None if deadline is None
                          else self.t_submit + deadline)
+        self.prefix_path: tuple = ()   # pinned store nodes (admit)
+        self.weights_ver = -1          # engine weights at prefill time
+
+
+class _PrefixNode:
+    """One ``prefill_align``-sized block of a cached prefix: the K/V
+    rows for its token block as device arrays (one ``[1, KVH, align,
+    D|1]`` segment per 4-D cache leaf, in flatten order — envelope-
+    free, so one store serves every bucket)."""
+
+    __slots__ = ("key", "parent", "children", "segments", "nbytes",
+                 "refs", "last_use")
+
+    def __init__(self, key, parent, segments):
+        self.key = key
+        self.parent = parent
+        self.children: dict = {}
+        self.segments = segments
+        self.nbytes = sum(int(s.nbytes) for s in segments)
+        self.refs = 0
+        self.last_use = 0
+
+
+class _PrefixStore:
+    """Host-side longest-prefix index over aligned token-id blocks
+    (the RadixAttention idea at ``prefill_align`` granularity): a trie
+    whose node at depth ``d`` holds block ``d``'s K/V segments.
+    ``match`` walks a prompt's blocks to the longest cached path;
+    donation inserts a finished request's blocks (dedup'd);
+    ``evict_to_budget`` drops LRU childless unreferenced nodes until
+    total bytes fit the budget (live refs are pinned).  Mutated only
+    on the engine's stepping thread, except ``clear`` which the
+    engine serializes under its admission lock."""
+
+    def __init__(self, align: int, budget: int):
+        self.align = align
+        self.budget = budget
+        self.root = _PrefixNode(None, None, [])
+        self.nbytes = 0
+        self.n_nodes = 0
+        self._clock = 0
+        self.hits = self.misses = 0
+        self.evictions = self.invalidations = 0
+        self.tokens_saved = 0
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    def match(self, prompt, max_blocks: int) -> list[_PrefixNode]:
+        """Longest cached path over ``prompt``'s aligned blocks (at
+        most ``max_blocks`` — the caller caps it so at least one true
+        token remains to prefill the first-token logits)."""
+        node, path, a = self.root, [], self.align
+        for b in range(max_blocks):
+            child = node.children.get(
+                prompt[b * a:(b + 1) * a].tobytes())
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        for n in path:
+            self._touch(n)
+        return path
+
+    def insert(self, parent: _PrefixNode, key: bytes,
+               segments) -> _PrefixNode:
+        node = _PrefixNode(key, parent, segments)
+        parent.children[key] = node
+        self.nbytes += node.nbytes
+        self.n_nodes += 1
+        self._touch(node)
+        return node
+
+    def evict_to_budget(self) -> int:
+        """LRU eviction to the byte budget: only childless nodes with
+        zero refs are candidates (an interior node is implicitly
+        pinned by its descendants; a refed node by its live
+        requests), so eviction cascades leaf-first."""
+        evicted = 0
+        while self.nbytes > self.budget:
+            victim = None
+
+            def walk(node, victim=None):
+                for child in node.children.values():
+                    if not child.children and child.refs <= 0:
+                        if (victim is None
+                                or child.last_use < victim.last_use):
+                            victim = child
+                    else:
+                        victim = walk(child, victim)
+                return victim
+
+            victim = walk(self.root)
+            if victim is None:
+                break  # everything left is pinned
+            del victim.parent.children[victim.key]
+            self.nbytes -= victim.nbytes
+            self.n_nodes -= 1
+            self.evictions += 1
+            evicted += 1
+        return evicted
+
+    def clear(self) -> tuple:
+        """Drop every cached segment (weight swap / close); returns
+        ``(nodes, bytes)`` released.  Live requests keep their slot
+        COPIES — only future admissions are affected."""
+        n, b = self.n_nodes, self.nbytes
+        self.root = _PrefixNode(None, None, [])
+        self.n_nodes = 0
+        self.nbytes = 0
+        self.invalidations += 1
+        return n, b
 
 
 class _Pool:
     """One cache envelope: device pool + per-slot host bookkeeping."""
 
     __slots__ = ("env", "n_slots", "dec", "cache", "state", "reqs",
-                 "step_fn", "prefill_fn", "queue")
+                 "step_fn", "prefill_fn", "queue", "chunk_fn",
+                 "copy_fn", "extract_fn", "prefilling")
 
     def __init__(self, env, n_slots, dec):
         self.env = env
@@ -125,9 +271,19 @@ class _Pool:
         self.dec = dec
         self.reqs: list[Optional[_Request]] = [None] * n_slots
         self.queue: collections.deque[_Request] = collections.deque()
+        # slot -> pending chunk-prefill plan (insertion order = the
+        # order step() advances them, one chunk per pool per call)
+        self.prefilling: dict = {}
 
     def live(self) -> bool:
         return any(r is not None for r in self.reqs)
+
+    def decodable(self) -> bool:
+        """At least one occupied slot is PAST its prefill — a decode
+        step would produce a real token (mid-prefill slots ride along
+        as done rows; a pool of only those skips the dispatch)."""
+        return any(r is not None and s not in self.prefilling
+                   for s, r in enumerate(self.reqs))
 
 
 class DecodeEngine:
@@ -171,9 +327,22 @@ class DecodeEngine:
         (``None``: unbounded, the pre-fault-tolerance behavior).
       deadline: default per-request wall-clock budget in seconds (from
         submit; ``submit(deadline=...)`` overrides per request).  A
-        request past its deadline — still queued or mid-decode — is
-        finished with an ``error`` result instead of holding a slot
-        or queue position (``None``: no deadline).
+        request past its deadline — still queued, mid-prefill, or
+        mid-decode — is finished with an ``error`` result instead of
+        holding a slot or queue position (``None``: no deadline).
+      prefix_cache_bytes: byte budget for the shared-prefix KV store
+        (``None``: off).  Admitted prompts reuse the longest cached
+        aligned prefix via a device-to-device copy (zero model
+        FLOPs); finished requests donate their aligned prompt blocks
+        back; LRU eviction beyond the budget skips segments pinned by
+        live requests.  ``swap_variables`` invalidates the store.
+      prefill_chunk: chunked-prefill quantum in tokens (``None``: off;
+        must be a multiple of ``prefill_align``).  Prompts prefill as
+        a sequence of at-most-this-long compiled chunk programs, at
+        most one chunk per bucket per ``step()`` interleaved with
+        decode, bounding live slots' inter-token latency by the chunk
+        quantum instead of the longest neighbor prompt.  Deadlines
+        are re-checked between chunks.
     """
 
     def __init__(self, model, variables: Mapping, *, slots: int = 8,
@@ -184,7 +353,9 @@ class DecodeEngine:
                  top_p: Optional[float] = None, seed: int = 0,
                  donate: Optional[bool] = None,
                  queue_bound: Optional[int] = None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 prefix_cache_bytes: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         base = _decode_model(model)
         self.max_len = base.max_len
         self.vocab_size = base.vocab_size
@@ -215,6 +386,17 @@ class DecodeEngine:
             raise ValueError(
                 f"deadline must be positive seconds (or None); got "
                 f"{deadline}")
+        if prefix_cache_bytes is not None and prefix_cache_bytes < 1:
+            raise ValueError(
+                f"prefix_cache_bytes must be >= 1 (or None); got "
+                f"{prefix_cache_bytes}")
+        if prefill_chunk is not None and (
+                prefill_chunk < prefill_align
+                or prefill_chunk % prefill_align):
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be a positive "
+                f"multiple of prefill_align={prefill_align} — chunk "
+                "boundaries must land on the padded-shape grid")
         if buckets is None:
             buckets = {self.max_len: slots}
         elif isinstance(buckets, Mapping):
@@ -242,6 +424,17 @@ class DecodeEngine:
         self.top_p = top_p
         self.queue_bound = queue_bound
         self.deadline = deadline
+        self.prefix_cache_bytes = prefix_cache_bytes
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        # either lever routes admission through the segmented path;
+        # with both off the legacy one-shot prefill is untouched
+        self._segmented = (prefix_cache_bytes is not None
+                           or prefill_chunk is not None)
+        self._prefix = (_PrefixStore(self.prefill_align,
+                                     int(prefix_cache_bytes))
+                        if prefix_cache_bytes is not None else None)
+        self._weights_ver = 0
         self._key = jax.random.key(seed)
         self._n_rng = 0
         self._n_submitted = 0
@@ -286,6 +479,12 @@ class DecodeEngine:
         }
         pool.step_fn = self._make_step(pool)
         pool.prefill_fn = self._make_prefill(pool)
+        pool.chunk_fn = (self._make_chunk_prefill(pool)
+                         if self._segmented else None)
+        pool.copy_fn = (self._make_prefix_copy(pool)
+                        if self._prefix is not None else None)
+        pool.extract_fn = (self._make_prefix_extract(pool)
+                           if self._prefix is not None else None)
 
     def _make_step(self, pool: _Pool):
         dec, env = pool.dec, pool.env
@@ -373,6 +572,125 @@ class DecodeEngine:
 
         donate = (1, 2) if self._donate else ()
         return jax.jit(prefill_impl, donate_argnums=donate)
+
+    def _make_chunk_prefill(self, pool: _Pool):
+        """One compiled program per (bucket, chunk length) appending a
+        mid-prompt chunk into a slot's cache rows ``[start, start+T)``:
+        the slot's envelope is sliced out of the pool, the scalar
+        cache/pos indices are pointed at ``start``, and a DENSE-
+        attention clone runs the chunk (the blocked prefill kernels
+        are exact only from an empty cache; the dense cache read is
+        exact at ANY offset — rows at/after ``start`` are causally
+        masked until this very call overwrites them).  Slot state is
+        installed by the FINAL chunk only; until then the slot stays
+        ``done`` with its dead-write row parked at ``env - 1``, which
+        interleaved decode steps may rewrite harmlessly (a slot reads
+        that row only after overwriting it itself)."""
+        env = pool.env
+        dense = pool.dec.clone(attn="dense", attn_fn=None,
+                               flash_attn=False, blockwise_attn=False)
+        temp, top_k, top_p = self.temperature, self.top_k, self.top_p
+        pad_id = self.pad_id
+
+        def chunk_impl(variables, cache, state, chunk, slot, start,
+                       last_rel, is_final, n_left0, eos_id, rng):
+            t_c = chunk.shape[1]
+            self._traces["chunk_prefill", env, t_c] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="chunk_prefill", bucket=env,
+                padded=t_c).inc()
+            params = {"params": variables["params"]}
+
+            def pick(leaf):
+                if jnp.ndim(leaf) == 0:  # cache/pos index: the offset
+                    return jnp.asarray(start, leaf.dtype)
+                return jax.lax.dynamic_slice(
+                    leaf, (slot,) + (0,) * (leaf.ndim - 1),
+                    (1,) + leaf.shape[1:])
+
+            sub = jax.tree_util.tree_map(pick, cache)
+            logits, st = dense.apply({**params, "cache": sub}, chunk,
+                                     mutable=["cache"],
+                                     last_index=last_rel)
+            tok0 = _select(logits[:, -1].astype(jnp.float32), temp,
+                           top_k, top_p, rng)[0]
+
+            def merge(pool_leaf, new_leaf):
+                if jnp.ndim(new_leaf) == 0:
+                    return pool_leaf
+                return jax.lax.dynamic_update_slice(
+                    pool_leaf, new_leaf,
+                    (slot,) + (0,) * (new_leaf.ndim - 1))
+
+            # rows outside [start, start+T) of the sub-envelope are
+            # the pool's own rows read back unchanged, so the whole-
+            # envelope merge equals a chunk-rows-only write
+            cache = jax.tree_util.tree_map(merge, cache, st["cache"])
+            done0 = (n_left0 <= 0) | ((eos_id >= 0) & (tok0 == eos_id))
+            state = {
+                "tok": state["tok"].at[slot].set(
+                    jnp.where(is_final, tok0, pad_id)),
+                "pos": state["pos"].at[slot].set(
+                    jnp.where(is_final, start + last_rel + 1,
+                              env - 1)),
+                "n_left": state["n_left"].at[slot].set(
+                    jnp.where(is_final, n_left0, 0)),
+                "eos": state["eos"].at[slot].set(
+                    jnp.where(is_final, eos_id, -1)),
+                "done": state["done"].at[slot].set(
+                    jnp.where(is_final, done0, True)),
+            }
+            return cache, state, tok0
+
+        donate = (1, 2) if self._donate else ()
+        return jax.jit(chunk_impl, donate_argnums=donate)
+
+    def _make_prefix_copy(self, pool: _Pool):
+        """Device-to-device install of one cached ``align``-row block
+        into a slot (zero model FLOPs — the prefill work the prefix
+        cache eliminates).  One trace per bucket."""
+        env = pool.env
+
+        def copy_impl(cache, segments, slot, start):
+            self._traces["prefix_copy", env] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="prefix_copy",
+                bucket=env).inc()
+            leaves, treedef = jax.tree_util.tree_flatten(cache)
+            segs = iter(segments)
+            out = []
+            for leaf in leaves:
+                if jnp.ndim(leaf) == 0:  # slot state owns positions
+                    out.append(leaf)
+                    continue
+                out.append(jax.lax.dynamic_update_slice(
+                    leaf, next(segs), (slot, 0, start, 0)))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(copy_impl, donate_argnums=donate)
+
+    def _make_prefix_extract(self, pool: _Pool):
+        """Slice one ``align``-row block of a slot's cache out for
+        donation to the store — NO donation here: the pool keeps its
+        buffers, the store gets fresh ones.  One trace per bucket."""
+        env, align = pool.env, self.prefill_align
+
+        def extract_impl(cache, slot, start):
+            self._traces["prefix_extract", env] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="prefix_extract",
+                bucket=env).inc()
+            out = []
+            for leaf in jax.tree_util.tree_leaves(cache):
+                if jnp.ndim(leaf) == 0:
+                    continue
+                out.append(jax.lax.dynamic_slice(
+                    leaf, (slot, 0, start, 0),
+                    (1, leaf.shape[1], align, leaf.shape[3])))
+            return out
+
+        return jax.jit(extract_impl)
 
     # ---- admission ----------------------------------------------------
 
@@ -487,10 +805,13 @@ class DecodeEngine:
         boundary: ``step()``/``_admit`` snapshot ``self.variables``
         once per call, so in-flight requests finish their current
         quantum on the old weights and every later token uses the new
-        ones.  KV caches are NOT invalidated — a mid-request swap
-        serves a hybrid prefix (standard rolling-serve semantics);
-        drain the engine first (the gateway's rolling update does)
-        when that matters."""
+        ones.  Live-slot KV caches are NOT invalidated — a
+        mid-request swap serves a hybrid prefix (standard
+        rolling-serve semantics); drain the engine first (the
+        gateway's rolling update does) when that matters.  The
+        PREFIX STORE however IS invalidated: cached prefix K/V was
+        computed under the old weights, and reusing it after a swap
+        would be silently wrong for every future hit."""
         if self._closed:
             raise RuntimeError("engine is closed; swap after close()")
         new = dict(variables)
@@ -513,12 +834,27 @@ class DecodeEngine:
         # numpy): the step loop then reuses device buffers instead of
         # re-transferring the tree every dispatch
         new = jax.tree_util.tree_map(jnp.asarray, new)
+        inval = None
         with self._lock:
             self.variables = new
+            # in-flight requests whose KV was (partly) computed under
+            # the old weights must not donate it back post-swap
+            self._weights_ver += 1
+            if self._prefix is not None:
+                inval = self._prefix.clear()
         telemetry.metrics().counter("serving_weight_swaps_total").inc()
         telemetry.instant("weight_swap")
         flight_recorder.record("weight_swap",
                                leaves=len(new_leaves))
+        if inval is not None:
+            n_nodes, n_bytes = inval
+            telemetry.metrics().counter(
+                "serving_prefix_invalidations_total").inc()
+            telemetry.instant("prefix_invalidate", nodes=n_nodes,
+                              bytes=n_bytes)
+            flight_recorder.record("prefix_invalidate",
+                                   nodes=n_nodes, bytes=n_bytes,
+                                   reason="weight_swap")
 
     def _note_gauges(self, pool: _Pool) -> None:
         """Per-bucket queue-depth / slot-occupancy gauges — the levels
@@ -554,7 +890,6 @@ class DecodeEngine:
 
     def _admit(self) -> list[dict]:
         finished = []
-        m = telemetry.metrics()
         # weights are snapshotted ONCE per admission sweep, so a
         # concurrent swap_variables takes effect at the next step
         # boundary, never mid-sweep
@@ -568,43 +903,217 @@ class DecodeEngine:
                     if not pool.queue:
                         break
                     req = pool.queue.popleft()
-                t_p = len(req.prompt)
-                t_pad = min(pool.env,
-                            _ceil_to(t_p, self.prefill_align))
-                padded = np.full((1, t_pad), self.pad_id, np.int32)
-                padded[0, :t_p] = req.prompt
-                try:
-                    with telemetry.span("prefill", bucket=pool.env,
-                                        slot=slot, padded=t_pad,
-                                        request_id=req.rid):
-                        pool.cache, pool.state, tok0 = pool.prefill_fn(
-                            variables, pool.cache, pool.state,
-                            jnp.asarray(padded), slot, t_p - 1,
-                            req.max_new - 1,
-                            -1 if req.eos_id is None else req.eos_id,
-                            self._next_rng())
-                        req.tokens.append(int(tok0))
-                except Exception as e:
-                    # Per-request error isolation: a poisoned request
-                    # is finished with an ``error`` result — its slot
-                    # stays free and its neighbors keep decoding —
-                    # instead of the exception killing step() for
-                    # every slot.  (With buffer donation on, a failure
-                    # DURING execution can still poison the pool;
-                    # trace-/dispatch-time failures, the common case,
-                    # are fully isolated.)
-                    finished.append(self._finish_error(
-                        req, f"prefill_failed: {e!r}", pool.env))
-                    continue
-                req.t_first = telemetry.now()
-                m.counter("serving_tokens_total",
-                          bucket=pool.env).inc()
-                pool.reqs[slot] = req
-                if (req.max_new == 1
-                        or req.tokens[-1] == req.eos_id):
-                    finished.append(self._finish(pool, slot))
+                admit = (self._admit_segmented if self._segmented
+                         else self._prefill_whole)
+                finished.extend(admit(pool, slot, req, variables))
             self._note_gauges(pool)
         return finished
+
+    def _prefill_whole(self, pool: _Pool, slot: int, req: _Request,
+                       variables) -> list[dict]:
+        """The legacy one-shot prefill: one compiled program writes
+        the whole padded prompt into the slot and installs its state
+        (byte-identical behavior to the pre-prefix engine — the
+        compile guard pins it)."""
+        m = telemetry.metrics()
+        t_p = len(req.prompt)
+        t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
+        padded = np.full((1, t_pad), self.pad_id, np.int32)
+        padded[0, :t_p] = req.prompt
+        try:
+            with telemetry.span("prefill", bucket=pool.env,
+                                slot=slot, padded=t_pad,
+                                request_id=req.rid):
+                pool.cache, pool.state, tok0 = pool.prefill_fn(
+                    variables, pool.cache, pool.state,
+                    jnp.asarray(padded), slot, t_p - 1,
+                    req.max_new - 1,
+                    -1 if req.eos_id is None else req.eos_id,
+                    self._next_rng())
+                req.tokens.append(int(tok0))
+        except Exception as e:
+            # Per-request error isolation: a poisoned request is
+            # finished with an ``error`` result — its slot stays free
+            # and its neighbors keep decoding — instead of the
+            # exception killing step() for every slot.  (With buffer
+            # donation on, a failure DURING execution can still
+            # poison the pool; trace-/dispatch-time failures, the
+            # common case, are fully isolated.)
+            return [self._finish_error(
+                req, f"prefill_failed: {e!r}", pool.env)]
+        req.t_first = telemetry.now()
+        m.counter("serving_tokens_total", bucket=pool.env).inc()
+        pool.reqs[slot] = req
+        if req.max_new == 1 or req.tokens[-1] == req.eos_id:
+            return [self._finish(pool, slot)]
+        return []
+
+    def _admit_segmented(self, pool: _Pool, slot: int, req: _Request,
+                         variables) -> list[dict]:
+        """Prefix-cache + chunked admission: install the longest
+        cached prefix by device copy, then plan the uncached tail as
+        chunk programs (advanced by ``step()``, one per pool per
+        call).  A fully uncached prompt with chunking off falls back
+        to the legacy one-shot program — same compiled shapes, same
+        admission latency."""
+        m = telemetry.metrics()
+        t_p = len(req.prompt)
+        t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
+        align = self.prefill_align
+        start = 0
+        if self._prefix is not None:
+            store = self._prefix
+            path = store.match(req.prompt, (t_p - 1) // align)
+            if path:
+                start = len(path) * align
+                try:
+                    with telemetry.span("prefix_copy",
+                                        bucket=pool.env, slot=slot,
+                                        rows=start,
+                                        request_id=req.rid):
+                        for b, node in enumerate(path):
+                            pool.cache = pool.copy_fn(
+                                pool.cache, node.segments, slot,
+                                b * align)
+                except Exception as e:
+                    return [self._finish_error(
+                        req, f"prefill_failed: {e!r}", pool.env)]
+                for node in path:   # pin: LRU must not evict under us
+                    node.refs += 1
+                req.prefix_path = tuple(path)
+                store.hits += 1
+                store.tokens_saved += start
+                m.counter("serving_prefix_hits_total",
+                          bucket=pool.env).inc()
+                m.counter("serving_prefill_tokens_saved_total",
+                          bucket=pool.env).inc(start)
+            else:
+                store.misses += 1
+                m.counter("serving_prefix_misses_total",
+                          bucket=pool.env).inc()
+            m.gauge("serving_prefix_hit_rate").set(
+                store.hits / (store.hits + store.misses))
+        req.weights_ver = self._weights_ver
+        if start == 0 and self.prefill_chunk is None:
+            return self._prefill_whole(pool, slot, req, variables)
+        padded = np.full((1, t_pad), self.pad_id, np.int32)
+        padded[0, :t_p] = req.prompt
+        quantum = self.prefill_chunk or (t_pad - start)
+        chunks = []
+        for c0 in range(start, t_pad, quantum):
+            c1 = min(c0 + quantum, t_pad)
+            final = c1 == t_pad
+            # the true last token always lands in the final chunk
+            # (t_p - 1 >= t_pad - align >= its start); non-final
+            # chunks take any in-range row — their logits are unused
+            last_rel = (t_p - 1 - c0) if final else (c1 - c0 - 1)
+            chunks.append((c0, padded[:, c0:c1], last_rel, final))
+        pool.reqs[slot] = req
+        pool.prefilling[slot] = {"req": req, "chunks": chunks,
+                                 "next": 0}
+        if self.prefill_chunk is None:
+            # prefix-only mode: the single tail program runs NOW, so
+            # admission latency matches the legacy path
+            return self._advance_prefill(pool, slot, variables)
+        return []
+
+    def _advance_prefill(self, pool: _Pool, slot: int,
+                         variables) -> list[dict]:
+        """Run ONE pending prefill chunk for ``slot``.  The request's
+        deadline is re-checked first — between chunks, not only in
+        ``_shed_expired_queued`` — so a chunked long prompt cannot
+        ride out its own deadline mid-prefill."""
+        plan = pool.prefilling[slot]
+        req = plan["req"]
+        m = telemetry.metrics()
+        if req.deadline is not None and telemetry.now() > req.deadline:
+            pool.reqs[slot] = None
+            del pool.prefilling[slot]
+            m.counter("serving_shed_total", reason="deadline",
+                      bucket=pool.env).inc()
+            telemetry.instant("evict", bucket=pool.env, slot=slot,
+                              request_id=req.rid)
+            return [self._finish_error(req, "deadline_exceeded",
+                                       pool.env)]
+        c0, chunk, last_rel, final = plan["chunks"][plan["next"]]
+        try:
+            with telemetry.span("prefill_chunk", bucket=pool.env,
+                                slot=slot, start=c0,
+                                size=chunk.shape[1], final=final,
+                                request_id=req.rid):
+                pool.cache, pool.state, tok0 = pool.chunk_fn(
+                    variables, pool.cache, pool.state,
+                    jnp.asarray(chunk), slot, c0, last_rel, final,
+                    req.max_new - 1,
+                    -1 if req.eos_id is None else req.eos_id,
+                    self._next_rng())
+                if final:
+                    req.tokens.append(int(tok0))
+        except Exception as e:
+            # same per-request isolation contract as _prefill_whole
+            pool.reqs[slot] = None
+            del pool.prefilling[slot]
+            return [self._finish_error(
+                req, f"prefill_failed: {e!r}", pool.env)]
+        plan["next"] += 1
+        if not final:
+            return []
+        del pool.prefilling[slot]
+        req.t_first = telemetry.now()
+        m.counter("serving_tokens_total", bucket=pool.env).inc()
+        if req.max_new == 1 or req.tokens[-1] == req.eos_id:
+            return [self._finish(pool, slot)]
+        return []
+
+    def _prefix_unpin(self, req: _Request) -> None:
+        """Release the request's live refs on its matched prefix path
+        (idempotent: the path is cleared after the first call)."""
+        for node in req.prefix_path:
+            node.refs -= 1
+        req.prefix_path = ()
+
+    def _donate_prefix(self, pool: _Pool, slot: int,
+                       req: _Request) -> None:
+        """Donate the finished request's prompt K/V back to the store:
+        extract each whole ``prefill_align`` block not already cached
+        as envelope-free device segments, then evict down to the LRU
+        byte budget.  Best-effort — a failure here must never fail the
+        request it rides on."""
+        store = self._prefix
+        align = self.prefill_align
+        n = min(len(req.prompt) // align, pool.env // align)
+        inserted = False
+        try:
+            node = store.root
+            for b in range(n):
+                key = req.prompt[b * align:(b + 1) * align].tobytes()
+                child = node.children.get(key)
+                if child is None:
+                    segs = pool.extract_fn(pool.cache, slot, b * align)
+                    child = store.insert(node, key, segs)
+                    inserted = True
+                else:
+                    store._touch(child)
+                node = child
+        except Exception:
+            return
+        if inserted:
+            evicted = store.evict_to_budget()
+            if evicted:
+                telemetry.metrics().counter(
+                    "serving_prefix_evictions_total").inc(evicted)
+
+    def prefix_stats(self) -> dict:
+        """Host-side prefix-store counters (operator introspection;
+        the same numbers feed the metrics registry)."""
+        if self._prefix is None:
+            return {"enabled": False}
+        s = self._prefix
+        return {"enabled": True, "hits": s.hits, "misses": s.misses,
+                "evictions": s.evictions,
+                "invalidations": s.invalidations,
+                "tokens_saved": s.tokens_saved, "nodes": s.n_nodes,
+                "bytes": s.nbytes, "budget_bytes": s.budget}
 
     def _finish(self, pool: _Pool, slot: int) -> dict:
         """Evict the finished request and assemble its result dict.
@@ -627,6 +1136,17 @@ class DecodeEngine:
         req = pool.reqs[slot]
         pool.reqs[slot] = None
         self._inflight.discard(req.rid)
+        # unpin FIRST so this request's own path is evictable (but
+        # freshly touched) when its donation pushes over budget
+        self._prefix_unpin(req)
+        if (self._prefix is not None
+                and req.weights_ver == self._weights_ver):
+            # rows [0, t_p) still hold the prompt's K/V — decode only
+            # appended at pos >= t_p — so the slot is donated before
+            # the result is assembled.  A weights_ver mismatch means
+            # a swap landed mid-request: its KV is hybrid, never
+            # donated.
+            self._donate_prefix(pool, slot, req)
         t_finish = telemetry.now()
         ttft = req.t_first - req.t_submit
         latency = t_finish - req.t_submit
@@ -651,6 +1171,7 @@ class DecodeEngine:
         a request that never produced a token.  The request has already
         left its queue/slot."""
         self._inflight.discard(req.rid)
+        self._prefix_unpin(req)
         t_finish = telemetry.now()
         m = telemetry.metrics()
         m.counter("serving_request_errors_total", bucket=env).inc()
@@ -691,7 +1212,15 @@ class DecodeEngine:
         # lands atomically at the next step boundary (see _admit)
         variables = self.variables
         for pool in self._pools:
-            if not pool.live():
+            # chunked-prefill interleave: at most ONE chunk per pool
+            # per step, so a live slot's inter-token gap is bounded by
+            # one chunk program (+ one decode quantum), never the full
+            # prompt length
+            if pool.prefilling:
+                slot = next(iter(pool.prefilling))
+                finished.extend(
+                    self._advance_prefill(pool, slot, variables))
+            if not pool.decodable():
                 continue
             # the span covers dispatch AND the host sync (np.asarray),
             # so its duration is the true step-quantum latency
@@ -726,6 +1255,7 @@ class DecodeEngine:
                 if (req is not None and req.deadline is not None
                         and now > req.deadline):
                     pool.reqs[slot] = None
+                    pool.prefilling.pop(slot, None)
                     m.counter("serving_shed_total", reason="deadline",
                               bucket=pool.env).inc()
                     telemetry.instant("evict", bucket=pool.env,
@@ -768,8 +1298,11 @@ class DecodeEngine:
                         pool.reqs[slot] = None
                         out.append(self._finish_error(
                             req, "engine_closed", pool.env))
+                pool.prefilling.clear()
                 pool.cache = pool.state = None  # release the pool
                 self._note_gauges(pool)
+            if self._prefix is not None:
+                self._prefix.clear()  # release device segments
             self._closed = True
         flight_recorder.record("engine_closed", cancelled=len(out))
         flight_recorder.flush()

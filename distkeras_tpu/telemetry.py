@@ -998,7 +998,15 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "ttft_p95_s": (1.0, 10.0),            # seconds to first token
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
+    "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
 }
+
+#: Signals where LOW is bad: the comparison inverts (breach at/below
+#: the threshold) and a threshold pair must satisfy
+#: ``degraded_at >= critical_at``.  A collapsed prefix hit rate on a
+#: shared-prompt workload means admissions silently pay full prefill
+#: again (store thrash, post-swap cold start, or misrouted affinity).
+LOWER_IS_WORSE_SLO_SIGNALS = frozenset({"prefix_hit_rate"})
 
 
 def _merged_percentile(registry, name: str, q: float) -> float | None:
@@ -1020,10 +1028,12 @@ def _merged_percentile(registry, name: str, q: float) -> float | None:
 class SLOWatchdog:
     """Declarative health evaluator over a ``MetricsRegistry``.
 
-    Six signals (PS staleness p99, client retry rate, serving shed
-    rate, queue depth, TTFT p95, idle-worker fraction) are computed
-    from the registry's live metrics and compared against
-    ``(degraded_at, critical_at)`` thresholds; the worst breach decides
+    The signals (PS staleness p99, client retry rate, serving shed
+    rate, queue depth, TTFT p95, idle-worker fraction, gateway
+    failover rate, prefix hit rate) are computed from the registry's
+    live metrics and compared against ``(degraded_at, critical_at)``
+    thresholds — inverted for ``LOWER_IS_WORSE_SLO_SIGNALS``, where a
+    LOW value breaches; the worst breach decides
     the ``ok`` / ``degraded`` / ``critical`` state.  ``evaluate()`` is
     a cheap one-shot pass (the ``/healthz`` endpoint calls it per
     request); ``start()`` adds a background thread that re-evaluates
@@ -1043,7 +1053,13 @@ class SLOWatchdog:
                         f"unknown SLO signal {k!r}; expected one of "
                         f"{sorted(DEFAULT_SLO_THRESHOLDS)}")
                 d, c = float(pair[0]), float(pair[1])
-                if d > c:
+                if k in LOWER_IS_WORSE_SLO_SIGNALS:
+                    if d < c:
+                        raise ValueError(
+                            f"SLO signal {k!r} breaches LOW: "
+                            f"degraded_at ({d}) must not be below "
+                            f"critical_at ({c})")
+                elif d > c:
                     raise ValueError(
                         f"SLO signal {k!r}: degraded_at ({d}) must "
                         f"not exceed critical_at ({c})")
@@ -1090,6 +1106,13 @@ class SLOWatchdog:
             # the gateway shows up here even while every request still
             # completes (the gateway hides the failures it absorbs)
             out["failover_rate"] = gfails / max(groutes, 1.0)
+        phits = r.sum_counter("serving_prefix_hits_total")
+        pmiss = r.sum_counter("serving_prefix_misses_total")
+        if phits or pmiss:
+            # fraction of prefix-store lookups that reused cached KV;
+            # inverted signal (see LOWER_IS_WORSE_SLO_SIGNALS) — a
+            # LOW rate on a shared-prefix workload is the breach
+            out["prefix_hit_rate"] = phits / max(phits + pmiss, 1.0)
         return out
 
     # -- evaluation ---------------------------------------------------
@@ -1100,8 +1123,12 @@ class SLOWatchdog:
         state, breaches = "ok", {}
         for k, v in sig.items():
             degraded_at, critical_at = self.thresholds[k]
-            level = ("critical" if v >= critical_at else
-                     "degraded" if v >= degraded_at else "ok")
+            if k in LOWER_IS_WORSE_SLO_SIGNALS:
+                level = ("critical" if v <= critical_at else
+                         "degraded" if v <= degraded_at else "ok")
+            else:
+                level = ("critical" if v >= critical_at else
+                         "degraded" if v >= degraded_at else "ok")
             if level != "ok":
                 breaches[k] = {"value": v, "level": level,
                                "degraded_at": degraded_at,

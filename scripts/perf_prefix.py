@@ -1,0 +1,328 @@
+"""Shared-prefix KV cache + chunked prefill A/B (ISSUE 8 satellite):
+one shared-system-prompt workload, three questions.
+
+1. **Prefix A/B** — the SAME workload (a few shared prompt heads,
+   unique tails) through four engine arms: ``baseline`` (both knobs
+   off), ``prefix`` (``prefix_cache_bytes``), ``chunk``
+   (``prefill_chunk``), and ``both``.  Every arm is warmed with one
+   full pass (compiles every program AND brings the prefix store to
+   steady state), then timed.  Reports TTFT p50/p95 per arm, prefill
+   tokens saved as a fraction of all prompt tokens, and asserts all
+   four arms' greedy tokens are byte-identical — the optimization
+   must be invisible.
+2. **Interleave drill** — one live slot decodes while a max-length
+   prompt prefills next to it.  Each engine step yields the live slot
+   at most one token, so per-step wall time IS its inter-token gap;
+   with chunking off the admission step swallows the whole prefill
+   (one giant gap), with chunking on every gap is bounded by the
+   chunk quantum.  Reports the gap max/p95 and step count for both
+   arms (median over repeats).
+3. **Gate** — a ``serving_prefill_tokens_saved_per_sec`` candidate is
+   synthesized from the live telemetry registry (``from_registry``)
+   and fed through ``scripts/perf_regress.py`` — against the repo's
+   ``BENCH_*.json`` trajectories normally, or against a synthetic
+   trajectory from this very run in ``--smoke`` (where the gate must
+   pass and the ISSUE 8 acceptance criteria are asserted: >= 50%
+   prefill tokens eliminated at steady state, TTFT p50 improved vs
+   cache-off, and the chunked arm's worst inter-token gap strictly
+   under the unchunked arm's).
+
+Usage:  PYTHONPATH=/root/repo python scripts/perf_prefix.py
+        [--smoke] [--prefill-chunk 32] [--prefix-cache-mb 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+if str(REPO / "scripts") not in sys.path:
+    sys.path.insert(0, str(REPO / "scripts"))
+
+import numpy as np
+
+import perf_regress
+
+
+def build_workload(args):
+    """``--requests`` prompts over ``--shared-heads`` distinct
+    ``--head-len``-token heads with unique tails — the system-prompt
+    traffic shape the prefix store exists for."""
+    rng = np.random.default_rng(args.seed)
+    heads = [rng.integers(0, args.vocab, (args.head_len,))
+             .astype(np.int32) for _ in range(args.shared_heads)]
+    work = []
+    for i in range(args.requests):
+        tail = rng.integers(
+            0, args.vocab,
+            (int(rng.integers(args.tail_lo, args.tail_hi + 1)),)
+        ).astype(np.int32)
+        work.append({"prompt": np.concatenate(
+            [heads[i % len(heads)], tail]), "n_new": args.new})
+    return work
+
+
+def _percentiles(xs):
+    return (round(float(np.percentile(xs, 50)), 5),
+            round(float(np.percentile(xs, 95)), 5))
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import ModelSpec, model_config
+
+    spec = model_config(
+        "transformer_lm", (args.max_len,), input_dtype="int32",
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        max_len=args.max_len, dtype=args.dtype)
+    model = ModelSpec.from_config(spec).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, args, **kw):
+    from distkeras_tpu.serving import DecodeEngine
+
+    kw.setdefault("slots", args.slots)
+    return DecodeEngine(model, variables,
+                        prefill_align=args.prefill_align,
+                        max_new_tokens=args.new, **kw)
+
+
+def run_arm(model, variables, work, args, *, prefix=False,
+            chunk=False):
+    """One engine arm: warm pass (compiles + store steady state),
+    then the timed pass.  Token savings are measured on the timed
+    pass only — the steady-state fraction, not the cold-start one."""
+    kw = {}
+    if prefix:
+        kw["prefix_cache_bytes"] = args.prefix_cache_mb << 20
+    if chunk:
+        kw["prefill_chunk"] = args.prefill_chunk
+    reqs = [{"prompt": w["prompt"], "max_new_tokens": w["n_new"]}
+            for w in work]
+    with _engine(model, variables, args, **kw) as eng:
+        list(eng.run(reqs))  # warm: programs + prefix store
+        saved0 = eng.prefix_stats().get("tokens_saved", 0)
+        p50s, p95s, wall = [], [], 0.0
+        for _ in range(args.passes):  # best-of-N vs host jitter
+            t0 = time.perf_counter()
+            results = list(eng.run(reqs))
+            wall += time.perf_counter() - t0
+            assert all(r.get("error") is None for r in results), \
+                results
+            p50, p95 = _percentiles([r["ttft"] for r in results])
+            p50s.append(p50)
+            p95s.append(p95)
+        saved = (eng.prefix_stats().get("tokens_saved", 0)
+                 - saved0) / args.passes
+    ttft_p50, ttft_p95 = min(p50s), min(p95s)
+    prompt_tok = sum(len(w["prompt"]) for w in work)
+    report = {"prefix": prefix, "chunk": chunk,
+              "wall_s": round(wall, 4),
+              "goodput_tok_s": round(
+                  args.passes * sum(w["n_new"] for w in work)
+                  / wall, 1),
+              "ttft_p50_s": ttft_p50, "ttft_p95_s": ttft_p95,
+              "prefill_tokens_saved": int(saved),
+              "prompt_tokens": int(prompt_tok),
+              "saved_frac": round(saved / prompt_tok, 3)}
+    tokens = [np.asarray(r["tokens"]) for r in results]
+    return report, tokens
+
+
+def run_interleave(model, variables, args, chunk):
+    """Live slot's per-step inter-token gaps while a max-length
+    prompt prefills beside it (see module docstring); one warm drill
+    first, then the median-of-repeats max/p95."""
+    rng = np.random.default_rng(args.seed + 1)
+    live = rng.integers(0, args.vocab, (8,)).astype(np.int32)
+    a = args.prefill_align
+    t_long = (args.max_len - args.new) // a * a
+    long = rng.integers(0, args.vocab, (t_long,)).astype(np.int32)
+    live_new = args.max_len - 8 - 4
+    kw = {"prefill_chunk": args.prefill_chunk} if chunk else {}
+    maxes, p95s, counts = [], [], []
+    with _engine(model, variables, args, slots=2, **kw) as eng:
+        for rep in range(args.drill_repeats + 1):
+            eng.submit(live, max_new_tokens=live_new,
+                       request_id=f"live{rep}")
+            eng.step()  # live prefill; it decodes from here on
+            eng.submit(long, max_new_tokens=args.new,
+                       request_id=f"long{rep}")
+            stamps, results = [], {}
+            while eng.has_work():
+                t0 = time.perf_counter()
+                for r in eng.step():
+                    assert r.get("error") is None, r
+                    results[r["request_id"]] = r
+                stamps.append((t0, time.perf_counter() - t0))
+            # the window: steps from the long submit until its first
+            # token materialized (telemetry.now IS perf_counter)
+            t_first = results[f"long{rep}"]["t_first"]
+            gaps = [dt for t0, dt in stamps if t0 < t_first]
+            if rep == 0:
+                continue  # warm drill: compile time pollutes gaps
+            maxes.append(max(gaps))
+            p95s.append(float(np.percentile(gaps, 95)))
+            counts.append(len(gaps))
+    # best-of-repeats: the floor is the structural cost, noise only
+    # ever inflates a repeat above it
+    return {"chunk": bool(chunk),
+            "prefill_window_steps": int(np.median(counts)),
+            "intertoken_max_s": round(float(np.min(maxes)), 5),
+            "intertoken_p95_s": round(float(np.min(p95s)), 5)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shapes + the ISSUE 8 acceptance "
+                         "assertions (the tier-1 registration)")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--shared-heads", type=int, default=4)
+    ap.add_argument("--head-len", type=int, default=192)
+    ap.add_argument("--tail-lo", type=int, default=8)
+    ap.add_argument("--tail-hi", type=int, default=24)
+    ap.add_argument("--new", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prefill-align", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--prefix-cache-mb", type=int, default=64)
+    ap.add_argument("--drill-repeats", type=int, default=3)
+    ap.add_argument("--passes", type=int, default=2,
+                    help="timed passes per arm; TTFT percentiles are "
+                         "best-of (floor = structural cost)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None,
+                    help="artifact directory (flight recorder, "
+                         "registry snapshot, smoke gate trajectory)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="perf_regress gate slack")
+    args = ap.parse_args()
+
+    if args.smoke:
+        # big enough that a 64-token prefill costs visibly more than
+        # a handful of block-copy dispatches, small enough for CPU CI
+        args.layers, args.d_model, args.heads = 2, 256, 4
+        args.vocab, args.max_len, args.dtype = 64, 64, "float32"
+        args.requests, args.shared_heads = 8, 2
+        args.head_len, args.tail_lo, args.tail_hi = 48, 4, 6
+        args.new, args.slots = 4, 4
+        args.prefill_align, args.prefill_chunk = 16, 16
+        args.drill_repeats, args.passes = 5, 3
+
+    out_dir = pathlib.Path(args.out_dir
+                           or tempfile.mkdtemp(prefix="dkt_pfx_"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    from distkeras_tpu import flight_recorder, telemetry
+
+    tel = telemetry.enable()
+    flight_recorder.start(out_dir / "fdr")
+    model, variables = _build_model(args)
+    work = build_workload(args)
+
+    out = {"metric": "prefix_cache_chunked_prefill_ab",
+           "model": f"lm L{args.layers} d{args.d_model}",
+           "requests": args.requests,
+           "shared_heads": args.shared_heads,
+           "head_len": args.head_len, "arms": {}}
+
+    t_run0 = time.perf_counter()
+    arms = {"baseline": {}, "prefix": {"prefix": True},
+            "chunk": {"chunk": True},
+            "both": {"prefix": True, "chunk": True}}
+    tokens = {}
+    for name, sel in arms.items():
+        out["arms"][name], tokens[name] = run_arm(
+            model, variables, work, args, **sel)
+    run_seconds = time.perf_counter() - t_run0
+
+    # the optimization must be INVISIBLE: byte-identical greedy tokens
+    for name in ("prefix", "chunk", "both"):
+        for i, (got, want) in enumerate(zip(tokens[name],
+                                            tokens["baseline"])):
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"arm {name} request {i}")
+    out["parity"] = "byte_identical"
+    out["ttft_p50_speedup"] = round(
+        out["arms"]["baseline"]["ttft_p50_s"]
+        / max(out["arms"]["prefix"]["ttft_p50_s"], 1e-9), 3)
+
+    out["interleave"] = {
+        "unchunked": run_interleave(model, variables, args, False),
+        "chunked": run_interleave(model, variables, args, True)}
+
+    snap_path = out_dir / "registry.json"
+    snap_path.write_text(json.dumps(tel.metrics.snapshot(),
+                                    default=repr))
+    flight_recorder.stop()
+    telemetry.disable()
+
+    # ---- the perf_regress hookup: registry counter -> rate candidate
+    cands = perf_regress.from_registry(
+        str(snap_path), "serving_prefill_tokens_saved_per_sec",
+        "serving_prefill_tokens_saved_total", run_seconds)
+    cands.append({"metric": "prefix_goodput_tok_s",
+                  "value": out["arms"]["both"]["goodput_tok_s"]})
+    if args.smoke:
+        # synthetic trajectory from this very run — the gate must pass
+        for i, c in enumerate(cands):
+            for n in (1, 2, 3):
+                (out_dir / f"BENCH_c{i}_r{n:02d}.json").write_text(
+                    json.dumps({
+                        "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                        "parsed": {"metric": c["metric"],
+                                   "value": c["value"] * (1 + 0.02 * n),
+                                   "unit": "per_sec"}}))
+        baselines = str(out_dir / "BENCH_*.json")
+    else:
+        baselines = perf_regress.DEFAULT_BASELINES
+    rows = perf_regress.evaluate(
+        cands, perf_regress.load_trajectories(baselines),
+        tolerance=0.5 if args.smoke else args.tolerance)
+    print(perf_regress.render(rows))
+    out["gate"] = [{k: r[k] for k in ("metric", "value", "status")}
+                   for r in rows]
+
+    if args.smoke:
+        # acceptance: >= 50% of steady-state prefill eliminated...
+        assert out["arms"]["prefix"]["saved_frac"] >= 0.5, out["arms"]
+        assert out["arms"]["both"]["saved_frac"] >= 0.5, out["arms"]
+        # ...TTFT improved vs cache-off...
+        assert (out["arms"]["prefix"]["ttft_p50_s"]
+                < out["arms"]["baseline"]["ttft_p50_s"]), out["arms"]
+        # ...and the chunked arm's worst inter-token gap is bounded
+        # by the chunk quantum, not the full prompt: strictly under
+        # the unchunked arm's monolithic-prefill gap, over a window
+        # of several steps (the prefill really was interleaved)
+        il = out["interleave"]
+        assert (il["chunked"]["intertoken_max_s"]
+                < il["unchunked"]["intertoken_max_s"]), il
+        assert (il["chunked"]["prefill_window_steps"]
+                > il["unchunked"]["prefill_window_steps"]), il
+        assert all(r["status"] == "pass" for r in rows), rows
+        out["smoke"] = "ok"
+    print(json.dumps(out, default=repr))
+
+
+if __name__ == "__main__":
+    main()

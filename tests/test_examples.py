@@ -28,6 +28,7 @@ SMOKE_SCRIPTS = {
     "obs_report.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
+    "perf_prefix.py": ["--smoke"],
     "perf_regress.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],

@@ -130,6 +130,53 @@ def test_session_affinity_is_sticky_and_spreads_keys():
         [len(r.dispatched) for r in reps])
 
 
+def test_prefix_affinity_routes_shared_heads_together():
+    """ISSUE 8: requests sharing their first ``prefix_block`` tokens
+    land on ONE replica (whose prefix store is warm); distinct heads
+    spread, and a tail difference beyond the head does not split a
+    group."""
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    rng = np.random.default_rng(13)
+    heads = [rng.integers(0, 37, (8,)).astype(np.int32)
+             for _ in range(5)]
+    with ServingGateway(reps, policy="prefix", prefix_block=8) as gw:
+        for head in heads:
+            for _ in range(3):  # same head, different tails
+                tail = rng.integers(0, 37, (4,)).astype(np.int32)
+                gw.result(gw.submit(np.concatenate([head, tail])),
+                          timeout=5)
+    total = 0
+    for r in reps:
+        total += len(r.dispatched)
+        assert len(r.dispatched) % 3 == 0  # whole groups, never split
+    assert total == 15
+    assert sum(1 for r in reps if r.dispatched) >= 2, (
+        [len(r.dispatched) for r in reps])
+
+
+def test_prefix_affinity_rehashes_over_survivors():
+    """A dead replica's prefix key range rehashes deterministically
+    over the survivors — affinity composes with failover."""
+    reps = [_FakeReplica(f"r{i}") for i in range(3)]
+    prompt = np.arange(10, dtype=np.int32)
+    with ServingGateway(reps, policy="prefix", retries=4,
+                        backoff_base=0.005) as gw:
+        gw.result(gw.submit(prompt), timeout=5)
+        (home,) = [r for r in reps if r.dispatched]
+        home.alive = False
+        for _ in range(4):
+            gw.result(gw.submit(prompt), timeout=5)
+    survivors = [r for r in reps if r is not home and r.dispatched]
+    assert len(survivors) == 1  # rehash is sticky too
+    assert len(survivors[0].dispatched) == 4
+
+
+def test_prefix_block_validation():
+    with pytest.raises(ValueError, match="prefix_block"):
+        ServingGateway([_FakeReplica("a")], policy="prefix",
+                       prefix_block=0)
+
+
 def test_failover_routes_around_a_failing_replica():
     reps = [_FakeReplica("a", fail_first=10), _FakeReplica("b")]
     with ServingGateway(reps, policy="round_robin", retries=3,
@@ -390,6 +437,46 @@ def test_rolling_update_from_snapshot_file(tmp_path):
         res = gw.result(gw.submit(p), timeout=60)
     np.testing.assert_array_equal(
         res["tokens"], _want(model, {"params": new_params}, p, 5))
+
+
+def test_rolling_update_invalidates_replica_prefix_stores(flight):
+    """ISSUE 8 regression: a rolling update must clear every
+    replica's prefix store (stale KV under new weights is silently
+    wrong) — post-rollout outputs are byte-identical to a cold engine
+    on the new weights even though the fleet served warm caches."""
+    model, variables = _model()
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.8,
+                                        variables["params"])
+    reps = [EngineReplica(
+        _engine(model, variables, prefix_cache_bytes=1 << 24),
+        name=f"r{i}") for i in range(2)]
+    rng = np.random.default_rng(21)
+    head = rng.integers(0, 37, (12,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [head, rng.integers(0, 37, (4,)).astype(np.int32)])
+        for _ in range(4)]
+    with ServingGateway(reps, policy="prefix",
+                        prefix_block=12) as gw:
+        for _ in range(2):  # second wave hits the warm store
+            for p in prompts:
+                assert "error" not in gw.result(gw.submit(p),
+                                                timeout=60)
+        assert sum(r.engine.prefix_stats()["nodes"]
+                   for r in reps) > 0
+        report = gw.rolling_update({"params": new_params},
+                                   quiesce_timeout=60)
+        assert report["updated"] == ["r0", "r1"]
+        for rep in reps:
+            st = rep.engine.prefix_stats()
+            assert st["nodes"] == 0 and st["invalidations"] >= 1, st
+        post = [gw.result(gw.submit(p), timeout=60) for p in prompts]
+    new_vars = {"params": new_params}
+    for p, r in zip(prompts, post):
+        assert "error" not in r
+        np.testing.assert_array_equal(
+            r["tokens"], _want(model, new_vars, p, 5))
+    kinds = [e["kind"] for e in flight.read_events()]
+    assert "prefix_invalidate" in kinds
 
 
 def test_rolling_update_rolls_back_on_critical_health(flight):

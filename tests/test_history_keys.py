@@ -107,6 +107,37 @@ def _collect_emitted() -> set[str]:
     return emitted
 
 
+def test_serving_prefix_telemetry_keys_are_documented():
+    """ISSUE 8 extension of the lint: every prefix-cache /
+    chunked-prefill telemetry name the serving layer emits (metric
+    names, span names, the flight kind) must appear in docs/API.md —
+    grep'd from the SOURCE so a renamed emission breaks the lint, not
+    just the docs."""
+    src = (DOCS.parent.parent
+           / "distkeras_tpu/serving.py").read_text()
+    emitted = set(re.findall(
+        r'"(serving_prefix_[a-z_]+|serving_prefill_tokens_saved_total'
+        r'|prefix_copy|prefill_chunk|prefix_invalidate)"', src))
+    # the full surface must actually be emitted by serving.py...
+    core = {"serving_prefix_hits_total", "serving_prefix_misses_total",
+            "serving_prefix_evictions_total",
+            "serving_prefix_invalidations_total",
+            "serving_prefill_tokens_saved_total",
+            "serving_prefix_hit_rate", "prefix_copy", "prefill_chunk",
+            "prefix_invalidate"}
+    assert core <= emitted, sorted(core - emitted)
+    # ...and every emitted name must be documented
+    docs = DOCS.read_text()
+    undocumented = {k for k in emitted if k not in docs}
+    assert not undocumented, (
+        f"serving prefix telemetry keys emitted but missing from "
+        f"docs/API.md: {sorted(undocumented)}")
+    # the flight kind has a row in the kind table specifically
+    assert re.search(r"^\| `prefix_invalidate` \|", docs, re.M), (
+        "docs/API.md flight-recorder kind table lacks "
+        "`prefix_invalidate`")
+
+
 def test_every_emitted_history_key_is_documented():
     documented = documented_keys()
     emitted = _collect_emitted()

@@ -500,6 +500,35 @@ def test_slo_watchdog_thresholds_and_transitions(tel):
     assert final["state"] == "ok"
 
 
+def test_prefix_hit_rate_slo_signal_breaches_low(tel):
+    """ISSUE 8: ``prefix_hit_rate`` is an INVERTED signal — a LOW
+    rate (store thrash / post-swap cold start) is the breach, never a
+    high one — with the threshold validation inverted to match."""
+    reg = telemetry.MetricsRegistry()
+    w = telemetry.SLOWatchdog(reg)
+    assert w.evaluate()["state"] == "ok"  # no lookups != outage
+    hits = reg.counter("serving_prefix_hits_total", bucket=32)
+    miss = reg.counter("serving_prefix_misses_total", bucket=32)
+    hits.inc(90)
+    miss.inc(10)  # 0.90 hit rate: healthy
+    v = w.evaluate()
+    assert v["signals"]["prefix_hit_rate"] == pytest.approx(0.90)
+    assert "prefix_hit_rate" not in v["breaches"]
+    miss.inc(900)  # rate collapses to 0.09 <= degraded_at 0.10
+    v = w.evaluate()
+    assert v["breaches"]["prefix_hit_rate"]["level"] == "degraded"
+    miss.inc(8000)  # ~0.01 <= critical_at 0.01
+    v = w.evaluate()
+    assert v["state"] == "critical"
+    assert v["breaches"]["prefix_hit_rate"]["level"] == "critical"
+    # custom thresholds: inverted pairs validate the inverted way
+    telemetry.SLOWatchdog(reg, thresholds={
+        "prefix_hit_rate": (0.5, 0.2)})  # degraded ABOVE critical: ok
+    with pytest.raises(ValueError, match="breaches LOW"):
+        telemetry.SLOWatchdog(reg, thresholds={
+            "prefix_hit_rate": (0.2, 0.5)})
+
+
 # ---- trace context + wire header --------------------------------------
 
 def test_trace_context_nesting_and_wire_header(tel):
