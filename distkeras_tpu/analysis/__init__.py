@@ -1,0 +1,113 @@
+"""Concurrency & protocol static-analysis suite (ISSUE 9).
+
+Three passes over the package, run together by
+``scripts/lint_static.py`` and proven on seeded violations by
+``tests/test_static_analysis.py``:
+
+- :mod:`~distkeras_tpu.analysis.lockcheck` — AST lock-discipline lint:
+  blocking calls under a held lock, lock-order inversions, and writes
+  escaping the lock that guards an attribute elsewhere.
+- :mod:`~distkeras_tpu.analysis.racecheck` — opt-in RUNTIME detector:
+  Eraser-style lockset race detection plus wait-for-graph deadlock
+  detection, with a disabled-by-default no-op fast path (the factories
+  hand back plain ``threading`` primitives when off).
+- :mod:`~distkeras_tpu.analysis.surfaces` — surface-drift lint: every
+  telemetry metric/span name, flight-recorder kind, SLO signal, history
+  key, and wire opcode is AST-extracted and cross-checked against
+  ``docs/API.md`` and ``transport.WIRE_OPS``.
+
+Findings are suppressed in place with ``# lint: allow(<rule>)`` (plus a
+justification) on the flagged or preceding line, or — for triaged
+intentionals that span refactors — via the committed baseline file
+``scripts/lint_baseline.txt`` (one ``rule|path|message`` key per line).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+from dataclasses import dataclass
+
+#: package subtrees the AST passes walk (tests/scripts lint themselves)
+PACKAGE = "distkeras_tpu"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding, printable as ``path:line: [rule] message``."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> str:
+        """Line-number-free identity used by the committed baseline, so
+        unrelated edits shifting a file do not churn the baseline."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+
+def allowed_rules(lines: list[str], lineno: int) -> set[str]:
+    """Rules suppressed at 1-based ``lineno``: an ``# lint: allow(...)``
+    comment on the flagged line or anywhere in the contiguous comment
+    block directly above it (justifications usually wrap)."""
+    out: set[str] = set()
+
+    def scan(ln: int) -> None:
+        for m in _ALLOW_RE.finditer(lines[ln]):
+            out.update(r.strip() for r in m.group(1).split(","))
+
+    if 0 <= lineno - 1 < len(lines):
+        scan(lineno - 1)
+    ln = lineno - 2
+    while 0 <= ln < len(lines) and lines[ln].lstrip().startswith("#"):
+        scan(ln)
+        ln -= 1
+    return out
+
+
+def filter_suppressed(findings: list[Finding],
+                      sources: dict[str, list[str]]
+                      ) -> tuple[list[Finding], int]:
+    """Drop findings carrying an in-source ``allow`` for their rule.
+    ``sources`` maps repo-relative path -> source lines."""
+    kept, dropped = [], 0
+    for f in findings:
+        lines = sources.get(f.path)
+        if lines is not None and f.rule in allowed_rules(lines, f.line):
+            dropped += 1
+        else:
+            kept.append(f)
+    return kept, dropped
+
+
+def load_baseline(path: pathlib.Path) -> set[str]:
+    """Baseline keys (``Finding.baseline_key`` lines; ``#`` comments and
+    blanks ignored).  A missing file is an empty baseline."""
+    if not path.exists():
+        return set()
+    out = set()
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
+def package_files(repo_root: pathlib.Path) -> list[pathlib.Path]:
+    """All package source files, sorted for deterministic reports."""
+    root = repo_root / PACKAGE
+    return sorted(p for p in root.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+def read_sources(repo_root: pathlib.Path,
+                 paths: list[pathlib.Path]) -> dict[str, list[str]]:
+    return {p.relative_to(repo_root).as_posix():
+            p.read_text().splitlines() for p in paths}

@@ -1,0 +1,467 @@
+"""Lock-discipline AST lint (ISSUE 9 tentpole, pass 1 of 3).
+
+Models every lock in the package — ``self._lock = threading.Lock()``
+attributes, module-level locks, function-local locks, and the
+``racecheck.lock/rlock/condition`` instrumented factories — then walks
+each function tracking the set of locks held (``with`` scopes plus
+explicit ``acquire()``/``release()`` pairs, including the
+acquire-then-``try/finally`` idiom) and reports three rules:
+
+``blocking-call-under-lock``
+    A call that can block on the network, a thread, or the clock while
+    a lock is held: ``time.sleep``, ``transport.send_msg*`` /
+    ``recv_msg*`` / ``connect``, raw socket ``sendall/recv/accept``,
+    ``Thread.join``, and ``.wait(...)`` on anything that is NOT the
+    held lock itself (``cv.wait`` while holding ``cv`` is the condition
+    idiom and allowed; waiting on an Event or a different lock is not).
+
+``lock-order``
+    Two locks observed nesting in both orders anywhere in the package
+    (an AB/BA inversion against the global acquisition graph), or a
+    lock identity re-acquired while already held (the multi-instance
+    loop-acquisition pattern — safe only under an explicit ordering
+    argument, so it must carry an ``allow``).
+
+``guarded-write``
+    A write to ``self.<attr>`` outside any lock when the attribute is
+    lock-guarded elsewhere — by explicit ``# guarded-by: <lock>``
+    annotation on its ``__init__`` assignment, or inferred when the
+    majority (>= 2, and strictly more than unguarded) of its non-init
+    writes happen under a lock.  ``__init__`` writes and writes inside
+    ``*_locked`` / ``*_holding`` helpers (the repo's caller-holds-it
+    naming convention) are exempt.
+
+Everything is intraprocedural by design: cross-function holding is the
+runtime detector's job (:mod:`~distkeras_tpu.analysis.racecheck`).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+from . import Finding
+
+RULE_BLOCKING = "blocking-call-under-lock"
+RULE_ORDER = "lock-order"
+RULE_GUARDED = "guarded-write"
+
+# dotted call targets that block (network / clock / disk): the flight
+# recorder write+flushes to disk, so calling it under a lock extends
+# the critical section by an fsync-class latency — legal only where
+# the durability ordering demands it (annotated ``allow`` sites)
+_BLOCKING_DOTTED = {
+    "time.sleep",
+    "transport.connect", "transport.send_msg",
+    "transport.send_msg_gather", "transport.recv_msg",
+    "transport.recv_msg_into",
+    "socket.create_connection",
+    "flight_recorder.record", "flight_recorder.flush",
+}
+# bare names: the repo's module-local sleep shims
+_BLOCKING_NAMES = {"_sleep", "sleep"}
+# blocking methods regardless of receiver (sockets, file flushes)
+_BLOCKING_METHODS = {"sendall", "sendmsg", "recv", "recv_into",
+                     "accept", "flush"}
+# lock constructors (plain and racecheck-instrumented)
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "racecheck.lock", "racecheck.rlock", "racecheck.condition",
+    "Lock", "RLock", "Condition",
+}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` source text of a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    return _dotted(value.func) in _LOCK_CTORS
+
+
+@dataclass
+class _Module:
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    module_locks: set[str] = field(default_factory=set)
+    # (class, attr) -> lock name from a ``# guarded-by:`` annotation
+    guarded_by: dict[tuple[str, str], str] = field(default_factory=dict)
+    # class -> lock attribute names assigned in that class
+    class_locks: dict[str, set[str]] = field(default_factory=dict)
+
+
+@dataclass
+class _Write:
+    path: str
+    line: int
+    cls: str
+    attr: str
+    func: str
+    held: tuple[str, ...]
+
+
+class _Analysis:
+    """Whole-package state: pass 1 collects lock names and annotations,
+    pass 2 walks functions against the union of pass-1 knowledge."""
+
+    def __init__(self) -> None:
+        self.modules: list[_Module] = []
+        self.lock_attr_names: set[str] = set()
+        self.findings: list[Finding] = []
+        # acquisition graph: (outer, inner) -> first observed site
+        self.order_edges: dict[tuple[str, str], tuple[str, int]] = {}
+        self.writes: list[_Write] = []
+
+    # -- pass 1 --------------------------------------------------------
+
+    def collect(self, path: str, src: str) -> None:
+        tree = ast.parse(src, filename=path)
+        mod = _Module(path, tree, src.splitlines())
+        for node in tree.body:
+            for tgt, value in _assignments(node):
+                if isinstance(tgt, ast.Name) and _is_lock_ctor(value):
+                    mod.module_locks.add(tgt.id)
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks = mod.class_locks.setdefault(cls.name, set())
+            for node in ast.walk(cls):
+                for tgt, value in _assignments(node):
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        if _is_lock_ctor(value):
+                            locks.add(tgt.attr)
+                            self.lock_attr_names.add(tgt.attr)
+                        line = mod.lines[tgt.lineno - 1]
+                        m = _GUARDED_BY_RE.search(line)
+                        if m:
+                            mod.guarded_by[(cls.name, tgt.attr)] = (
+                                m.group(1))
+        self.lock_attr_names.update(mod.module_locks)
+        self.modules.append(mod)
+
+    # -- pass 2 --------------------------------------------------------
+
+    def analyze(self) -> list[Finding]:
+        for mod in self.modules:
+            walker = _FuncWalker(self, mod)
+            for node in mod.tree.body:
+                walker.visit_toplevel(node)
+        self._check_order_graph()
+        self._check_guarded_writes()
+        return self.findings
+
+    def note_edge(self, outer: str, inner: str, path: str, line: int
+                  ) -> None:
+        if outer == inner:
+            self.findings.append(Finding(
+                RULE_ORDER, path, line,
+                f"{inner} acquired while an instance of {outer} is "
+                f"already held (multi-instance nesting needs an "
+                f"ordering argument)"))
+            return
+        self.order_edges.setdefault((outer, inner), (path, line))
+
+    def _check_order_graph(self) -> None:
+        adj: dict[str, set[str]] = {}
+        for a, b in self.order_edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, stack = set(), [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(adj.get(n, ()))
+            return False
+
+        reported: set[frozenset[str]] = set()
+        for (a, b), (path, line) in sorted(self.order_edges.items()):
+            if reaches(b, a):
+                pair = frozenset((a, b))
+                if pair in reported:
+                    continue
+                reported.add(pair)
+                other = self.order_edges.get((b, a))
+                where = (f" (reverse order at {other[0]}:{other[1]})"
+                         if other else " (via intermediate locks)")
+                self.findings.append(Finding(
+                    RULE_ORDER, path, line,
+                    f"lock-order inversion: {a} -> {b} here but a "
+                    f"{b} -> {a} path exists elsewhere{where}"))
+
+    def _check_guarded_writes(self) -> None:
+        by_attr: dict[tuple[str, str, str], list[_Write]] = {}
+        for w in self.writes:
+            by_attr.setdefault((w.path, w.cls, w.attr), []).append(w)
+        annotated = {(m.path, cls, attr): lock
+                     for m in self.modules
+                     for (cls, attr), lock in m.guarded_by.items()}
+        for key, writes in sorted(by_attr.items()):
+            path, cls, attr = key
+            live = [w for w in writes
+                    if w.func != "__init__"
+                    and not w.func.endswith(("_locked", "_holding"))]
+            lock = annotated.get(key)
+            if lock is not None:
+                for w in live:
+                    if not any(h == lock
+                               or h.endswith("." + lock)
+                               or h.endswith(":" + lock)
+                               for h in w.held):
+                        self.findings.append(Finding(
+                            RULE_GUARDED, w.path, w.line,
+                            f"write to {cls}.{attr} outside its "
+                            f"declared guard {lock} (guarded-by "
+                            f"annotation)"))
+                continue
+            guarded = [w for w in live if w.held]
+            naked = [w for w in live if not w.held]
+            if len(guarded) >= 2 and len(guarded) > len(naked):
+                majority = guarded[0].held[-1]
+                for w in naked:
+                    self.findings.append(Finding(
+                        RULE_GUARDED, w.path, w.line,
+                        f"write to {cls}.{attr} without a lock, but "
+                        f"{len(guarded)} other writes hold one "
+                        f"(majority guard {majority})"))
+
+
+def _assignments(node: ast.AST):
+    """(target, value) pairs of plain/annotated assignments."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield t, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        yield node.target, node.value
+
+
+class _FuncWalker:
+    """Per-module linear walk of every function body, tracking held
+    locks.  Compound statements recurse with a copy of the held list;
+    ``try`` finalizers walk against the live list so the
+    acquire-then-``try/finally: release`` idiom balances."""
+
+    def __init__(self, analysis: _Analysis, mod: _Module) -> None:
+        self.a = analysis
+        self.mod = mod
+
+    def visit_toplevel(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._function(node, cls="")
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    self._function(sub, cls=node.name)
+
+    # -- lock identity -------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST, cls: str,
+                 local_locks: set[str]) -> str | None:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if name in local_locks:
+                return f"{self.mod.path}:{name}"
+            if name in self.mod.module_locks:
+                return f"{self.mod.path}:{name}"
+            return None
+        if parts[0] == "self" and len(parts) == 2:
+            if parts[1] in self.a.lock_attr_names:
+                return f"{cls or self.mod.path}.{parts[1]}"
+            return None
+        # e.g. ``s.lock`` / ``shard.lock``: identify by attribute name
+        if parts[-1] in self.a.lock_attr_names:
+            return f"*.{parts[-1]}"
+        return None
+
+    # -- function walk -------------------------------------------------
+
+    def _function(self, fn, cls: str,
+                  outer_locals: frozenset[str] = frozenset()) -> None:
+        local_locks = set(outer_locals)
+        for node in ast.walk(fn):
+            for tgt, value in _assignments(node):
+                if isinstance(tgt, ast.Name) and _is_lock_ctor(value):
+                    local_locks.add(tgt.id)
+                    self.a.lock_attr_names.add(tgt.id)
+        ctx = _Ctx(self, cls, fn.name, frozenset(local_locks))
+        ctx.walk(fn.body, [])
+
+
+class _Ctx:
+    def __init__(self, walker: _FuncWalker, cls: str, func: str,
+                 local_locks: frozenset[str]) -> None:
+        self.w = walker
+        self.cls = cls
+        self.func = func
+        self.local_locks = local_locks
+
+    def _lid(self, expr: ast.AST) -> str | None:
+        return self.w._lock_id(expr, self.cls, set(self.local_locks))
+
+    def _acquire(self, lid: str, held: list[str], line: int) -> None:
+        for h in held:
+            self.w.a.note_edge(h, lid, self.w.mod.path, line)
+        held.append(lid)
+
+    def walk(self, stmts, held: list[str]) -> None:
+        for st in stmts:
+            self._statement(st, held)
+
+    def _statement(self, st: ast.stmt, held: list[str]) -> None:
+        a = self.w.a
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in st.items:
+                lid = self._lid(item.context_expr)
+                if lid is not None:
+                    self._acquire(lid, inner, st.lineno)
+            self.walk(st.body, inner)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closures run later: analyze with a fresh (empty) held set
+            self.w._function(st, cls=self.cls,
+                             outer_locals=self.local_locks)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, list(held))
+            for h in st.handlers:
+                self.walk(h.body, list(held))
+            self.walk(st.orelse, list(held))
+            # the live list: releases in ``finally`` must balance the
+            # acquire that preceded the try statement
+            self.walk(st.finalbody, held)
+            return
+        if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            self._scan_exprs(self._headers(st), held)
+            self.walk(st.body, list(held))
+            self.walk(st.orelse, list(held))
+            return
+        # simple statement: explicit acquire()/release() bookkeeping
+        call = (st.value if isinstance(st, ast.Expr)
+                and isinstance(st.value, ast.Call) else None)
+        if call is not None and isinstance(call.func, ast.Attribute):
+            recv_lid = self._lid(call.func.value)
+            if recv_lid is not None and call.func.attr == "acquire":
+                self._acquire(recv_lid, held, st.lineno)
+                return
+            if recv_lid is not None and call.func.attr == "release":
+                if recv_lid in held:
+                    held.remove(recv_lid)
+                return
+        self._scan_exprs([st], held)
+        # track writes to self.<attr> with the current held set
+        for tgt, _ in _assignments(st):
+            self._note_write(tgt, held, st.lineno)
+        if isinstance(st, ast.AugAssign):
+            self._note_write(st.target, held, st.lineno)
+
+    def _note_write(self, tgt: ast.AST, held: list[str], line: int
+                    ) -> None:
+        if (self.cls and isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            self.w.a.writes.append(_Write(
+                self.w.mod.path, line, self.cls, tgt.attr,
+                self.func, tuple(held)))
+
+    @staticmethod
+    def _headers(st: ast.stmt) -> list[ast.AST]:
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return [st.iter]
+        if isinstance(st, (ast.If, ast.While)):
+            return [st.test]
+        return []
+
+    # -- blocking-call scan --------------------------------------------
+
+    def _scan_exprs(self, nodes: list[ast.AST], held: list[str]
+                    ) -> None:
+        if not held:
+            return
+        for root in nodes:
+            for node in ast.walk(root):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Call):
+                    self._check_call(node, held)
+
+    def _check_call(self, call: ast.Call, held: list[str]) -> None:
+        a = self.w.a
+        d = _dotted(call.func)
+        msg = None
+        if d in _BLOCKING_DOTTED:
+            msg = f"{d}() while holding {held[-1]}"
+        elif d in _BLOCKING_NAMES:
+            msg = f"{d}() while holding {held[-1]}"
+        elif isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            recv = call.func.value
+            if meth in _BLOCKING_METHODS:
+                msg = (f".{meth}() (blocking I/O) while holding "
+                       f"{held[-1]}")
+            elif meth == "join" and not self._join_exempt(recv):
+                msg = f".join() while holding {held[-1]}"
+            elif meth == "wait":
+                lid = self._lid(recv)
+                if lid is None or lid not in held:
+                    what = _dotted(recv) or "<expr>"
+                    msg = (f"{what}.wait() under {held[-1]} but "
+                           f"{what} is not the held lock")
+        if msg is not None:
+            a.findings.append(Finding(
+                RULE_BLOCKING, self.w.mod.path, call.lineno, msg))
+
+    @staticmethod
+    def _join_exempt(recv: ast.AST) -> bool:
+        """``"".join`` / ``b"".join`` / ``os.path.join`` are not
+        thread joins."""
+        if isinstance(recv, ast.Constant):
+            return isinstance(recv.value, (str, bytes))
+        d = _dotted(recv)
+        return d is not None and d.split(".")[-1] == "path"
+
+
+def analyze_paths(repo_root: pathlib.Path,
+                  paths: list[pathlib.Path]) -> list[Finding]:
+    """Run the lint over ``paths`` (package .py files) with one shared
+    lock-name universe and acquisition graph."""
+    a = _Analysis()
+    rels = [p.relative_to(repo_root).as_posix() for p in paths]
+    for rel, p in zip(rels, paths):
+        a.collect(rel, p.read_text())
+    return sorted(a.analyze(), key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_source(src: str, path: str = "<fixture>") -> list[Finding]:
+    """Single-source convenience for tests and seeded fixtures."""
+    a = _Analysis()
+    a.collect(path, src)
+    return sorted(a.analyze(), key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,248 @@
+"""Surface-drift lint (ISSUE 9 tentpole, pass 3 of 3).
+
+AST-extracts every externally visible *name* the runtime emits —
+telemetry metric names (``counter/gauge/histogram`` first args), span
+and instant names, flight-recorder event kinds, SLO signal names
+(``DEFAULT_SLO_THRESHOLDS`` keys), trainer history keys (keyword args
+of ``self._record(...)``), and single-byte wire opcodes in the wire
+modules — then cross-checks them against ``docs/API.md`` and the
+``transport.WIRE_OPS`` registry.  A renamed emission therefore breaks
+the lint, not just the docs; an opcode literal that is not registered
+(or is registered under a different protocol scope) is an error.
+
+``tests/test_history_keys.py`` builds on the same extractor, so the
+test and the lint can never disagree about what the surface is.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+from . import Finding
+
+RULE_METRIC = "undocumented-metric"
+RULE_SPAN = "undocumented-span"
+RULE_FLIGHT = "undocumented-flight-kind"
+RULE_SLO = "undocumented-slo-signal"
+RULE_HISTORY = "undocumented-history-key"
+RULE_OPCODE = "unregistered-opcode"
+
+#: wire modules and the WIRE_OPS protocol scope their byte literals
+#: belong to (transport itself only carries the frame-level trace tag)
+WIRE_SCOPES = {
+    "distkeras_tpu/parallel/host_ps.py": "ps",
+    "distkeras_tpu/parallel/sharded_ps.py": "ps",
+    "distkeras_tpu/gateway.py": "replica",
+    "distkeras_tpu/parallel/transport.py": "frame",
+}
+
+_Site = tuple[str, int]  # (path, line)
+
+
+@dataclass
+class Surface:
+    """Everything the package emits, each name -> first site seen."""
+
+    metrics: dict[str, _Site] = field(default_factory=dict)
+    spans: dict[str, _Site] = field(default_factory=dict)
+    flight_kinds: dict[str, _Site] = field(default_factory=dict)
+    slo_signals: dict[str, _Site] = field(default_factory=dict)
+    history_keys: dict[str, _Site] = field(default_factory=dict)
+    # scope -> opcode byte -> site
+    wire_ops: dict[str, dict[bytes, _Site]] = field(
+        default_factory=dict)
+
+    def merge(self, other: "Surface") -> None:
+        for name in ("metrics", "spans", "flight_kinds",
+                     "slo_signals", "history_keys"):
+            mine, theirs = getattr(self, name), getattr(other, name)
+            for k, site in theirs.items():
+                mine.setdefault(k, site)
+        for scope, ops in other.wire_ops.items():
+            mine = self.wire_ops.setdefault(scope, {})
+            for op, site in ops.items():
+                mine.setdefault(op, site)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_arg0(call: ast.Call) -> str | None:
+    if (call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return call.args[0].value
+    return None
+
+
+def extract_source(src: str, path: str,
+                   wire_scope: str | None = None) -> Surface:
+    """Extract the emission surface of one module's source text."""
+    s = Surface()
+    tree = ast.parse(src, filename=path)
+    if wire_scope is None:
+        wire_scope = WIRE_SCOPES.get(path)
+    # registry registrations are definitions, not uses: their byte
+    # literals are exempt from the wire-op scan
+    registration_consts: set[int] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and (_dotted(node.func) or "").endswith(
+                    "WIRE_OPS.register")):
+            registration_consts.update(
+                id(a) for a in node.args
+                if isinstance(a, ast.Constant))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            _extract_call(node, path, s)
+        elif (isinstance(node, ast.Assign)
+              and any(isinstance(t, ast.Name)
+                      and t.id == "DEFAULT_SLO_THRESHOLDS"
+                      for t in node.targets)
+              and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant):
+                    s.slo_signals.setdefault(
+                        k.value, (path, k.lineno))
+        elif (wire_scope is not None
+              and isinstance(node, ast.Constant)
+              and isinstance(node.value, bytes)
+              and len(node.value) == 1
+              and id(node) not in registration_consts):
+            s.wire_ops.setdefault(wire_scope, {}).setdefault(
+                node.value, (path, node.lineno))
+    return s
+
+
+def _extract_call(call: ast.Call, path: str, s: Surface) -> None:
+    func = call.func
+    meth = func.attr if isinstance(func, ast.Attribute) else None
+    d = _dotted(func)
+    site = (path, call.lineno)
+    if meth in ("counter", "gauge", "histogram"):
+        name = _str_arg0(call)
+        if name:
+            s.metrics.setdefault(name, site)
+    elif meth in ("span", "instant", "complete") or (
+            d in ("span", "instant", "complete")):
+        name = _str_arg0(call)
+        if name:
+            s.spans.setdefault(name, site)
+    elif d is not None and d.endswith("flight_recorder.record"):
+        name = _str_arg0(call)
+        if name:
+            s.flight_kinds.setdefault(name, site)
+    elif d is not None and d.endswith("._record"):
+        for kw in call.keywords:
+            if kw.arg:
+                s.history_keys.setdefault(kw.arg, site)
+
+
+def extract_paths(repo_root: pathlib.Path,
+                  paths: list[pathlib.Path]) -> Surface:
+    s = Surface()
+    for p in paths:
+        rel = p.relative_to(repo_root).as_posix()
+        s.merge(extract_source(p.read_text(), rel))
+    return s
+
+
+# -- docs cross-checks -------------------------------------------------
+
+
+def _word_in(name: str, text: str) -> bool:
+    return re.search(
+        rf"(?<![A-Za-z0-9_]){re.escape(name)}(?![A-Za-z0-9_])",
+        text) is not None
+
+
+def _table_rows(docs: str) -> set[str]:
+    """All first-column backticked identifiers of any docs table."""
+    return set(re.findall(r"^\| `([A-Za-z_]\w*)` \|", docs, re.M))
+
+
+def documented_history_keys(docs: str) -> set[str]:
+    """First-column keys of the 'Trainer history keys' table (the
+    parser ``tests/test_history_keys.py`` shares)."""
+    m = re.search(r"### Trainer history keys(.*?)(?:\n## |\Z)",
+                  docs, re.S)
+    if not m:
+        return set()
+    return set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+
+
+def check_docs(surface: Surface, docs: str) -> list[Finding]:
+    """Every extracted name must appear in docs/API.md: metrics and
+    span names anywhere as a whole word, flight kinds and SLO signals
+    as table rows, history keys as rows of the history-key table."""
+    out: list[Finding] = []
+    rows = _table_rows(docs)
+    hist = documented_history_keys(docs)
+    for name, (path, line) in sorted(surface.metrics.items()):
+        if not _word_in(name, docs):
+            out.append(Finding(
+                RULE_METRIC, path, line,
+                f"metric {name!r} emitted but absent from "
+                f"docs/API.md"))
+    for name, (path, line) in sorted(surface.spans.items()):
+        if not _word_in(name, docs):
+            out.append(Finding(
+                RULE_SPAN, path, line,
+                f"span/instant {name!r} emitted but absent from "
+                f"docs/API.md"))
+    for name, (path, line) in sorted(surface.flight_kinds.items()):
+        if name not in rows:
+            out.append(Finding(
+                RULE_FLIGHT, path, line,
+                f"flight-recorder kind {name!r} emitted but has no "
+                f"row in the docs/API.md kind table"))
+    for name, (path, line) in sorted(surface.slo_signals.items()):
+        if name not in rows:
+            out.append(Finding(
+                RULE_SLO, path, line,
+                f"SLO signal {name!r} defined but has no row in the "
+                f"docs/API.md threshold table"))
+    for name, (path, line) in sorted(surface.history_keys.items()):
+        if name not in hist:
+            out.append(Finding(
+                RULE_HISTORY, path, line,
+                f"history key {name!r} recorded but missing from the "
+                f"docs/API.md 'Trainer history keys' table"))
+    return out
+
+
+def check_opcodes(surface: Surface, registry=None) -> list[Finding]:
+    """Every single-byte literal in a wire module must be registered in
+    ``transport.WIRE_OPS`` under that module's protocol scope."""
+    if registry is None:
+        from distkeras_tpu.parallel.transport import WIRE_OPS
+        registry = WIRE_OPS
+    out: list[Finding] = []
+    for scope, ops in sorted(surface.wire_ops.items()):
+        known = registry.ops(scope)
+        for op, (path, line) in sorted(ops.items()):
+            if op not in known:
+                out.append(Finding(
+                    RULE_OPCODE, path, line,
+                    f"wire byte {op!r} used in scope {scope!r} but "
+                    f"not registered in transport.WIRE_OPS"))
+    return out
+
+
+def check_all(repo_root: pathlib.Path, paths: list[pathlib.Path],
+              docs_path: pathlib.Path | None = None) -> list[Finding]:
+    surface = extract_paths(repo_root, paths)
+    docs_path = docs_path or repo_root / "docs/API.md"
+    findings = check_docs(surface, docs_path.read_text())
+    findings += check_opcodes(surface)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -58,6 +58,7 @@ import time
 from typing import Any
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.analysis import racecheck
 
 
 class FlightRecorder:
@@ -79,7 +80,7 @@ class FlightRecorder:
         self.segment_events = int(segment_events)
         self.segments = int(segments)
         os.makedirs(self.directory, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("flight_recorder")
         self._seq = 0  # per-recorder monotone event index
         self._file = None
         self._file_events = 0

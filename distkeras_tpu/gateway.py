@@ -69,6 +69,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.serving import ShedError
 
@@ -93,7 +94,7 @@ class _Future:
     __slots__ = ("_lock", "_event", "_result", "_set")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("gateway.future")
         self._event = threading.Event()
         self._result = None
         self._set = False
@@ -145,10 +146,10 @@ class EngineReplica:
         self.engine = engine
         self.name = str(name)
         # RLock'd condition: load() re-enters from quiesce's wait loop
-        self._cv = threading.Condition(threading.RLock())
+        self._cv = racecheck.condition("gateway.replica_cv")
         self._mailbox: collections.deque = collections.deque()
         self._pending: dict[Any, Callable] = {}
-        self._alive = False
+        self._alive = False  # guarded-by: _cv
         self._stop_req = False
         self._killed = False
         self._thread: Optional[threading.Thread] = None
@@ -158,7 +159,8 @@ class EngineReplica:
     def start(self) -> "EngineReplica":
         if self._thread is not None:
             return self
-        self._alive = True
+        with self._cv:  # health() may race the spawn below
+            self._alive = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"dkt-replica-{self.name}")
@@ -537,9 +539,9 @@ class RemoteReplica:
         self.name = name if name is not None else f"{host}:{port}"
         self.attempt_timeout = attempt_timeout
         self.connect_timeout = connect_timeout
-        self._alive = True
-        self._lock = threading.Lock()
-        self._outstanding = 0
+        self._lock = racecheck.lock("gateway.remote")
+        self._alive = True  # guarded-by: _lock
+        self._outstanding = 0  # guarded-by: _lock
 
     def start(self) -> "RemoteReplica":
         return self  # the server owns the engine lifecycle
@@ -589,7 +591,8 @@ class RemoteReplica:
             self._exchange(b"h", timeout=self.connect_timeout)
         except (ConnectionError, OSError, ValueError):
             return False
-        self._alive = True
+        with self._lock:  # revival races dispatch's _mark_down
+            self._alive = True
         return True
 
     def dispatch(self, spec: Mapping, on_result: Callable) -> None:
@@ -769,14 +772,14 @@ class ServingGateway:
         self.jitter = float(jitter)
         self.deadline = deadline
         self._rng = np.random.default_rng(seed)
-        self._lock = threading.RLock()
+        self._lock = racecheck.rlock("gateway")
         self._requests: dict[Any, _GwRequest] = {}
-        self._rr = 0
+        self._rr = 0  # guarded-by: _lock
         self._n_auto = itertools.count()
         self._seq = itertools.count()  # retry-queue tiebreaker
         self._updating: set = set()  # replica names mid-swap
-        self._closing = False
-        self._started = False
+        self._closing = False  # guarded-by: _lock
+        self._started = False  # guarded-by: _lock
         self._retry_q: queue.PriorityQueue = queue.PriorityQueue()
         self._retry_thread: Optional[threading.Thread] = None
 

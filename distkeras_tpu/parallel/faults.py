@@ -51,6 +51,7 @@ from typing import Optional
 import numpy as np
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
 
 KINDS = ("reset", "truncate", "delay", "partition")
@@ -115,12 +116,12 @@ class ChaosTransport:
         self.skip_ops = int(skip_ops)
         self.target_ports = (None if target_ports is None
                              else {int(p) for p in target_ports})
-        self._lock = threading.Lock()
-        self._op = 0
-        self._injected = 0
+        self._lock = racecheck.lock("chaos")
+        self._op = 0  # guarded-by: _lock
+        self._injected = 0  # guarded-by: _lock
         self.counts: dict[str, int] = {k: 0 for k in KINDS}
-        self._orig = None
-        self._installed = False
+        self._orig = None  # guarded-by: _install_lock
+        self._installed = False  # guarded-by: _install_lock
 
     # -- schedule ----------------------------------------------------------
 

@@ -41,6 +41,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 
@@ -147,9 +148,9 @@ class HostParameterServer:
         commits after the last snapshot are recovered only if the
         client retries them (unacked); acked ones are rolled back."""
         self.rule = rule
-        self._lock = threading.Lock()
-        self._center = _to_numpy(center)
-        self._clock = 0
+        self._lock = racecheck.lock("host_ps")
+        self._center = _to_numpy(center)  # guarded-by: _lock
+        self._clock = 0  # guarded-by: _lock
         self._pull_clock: dict[int, int] = {}
         self.staleness_log: list[int] = []
         self.num_commits = 0
@@ -214,6 +215,9 @@ class HostParameterServer:
                 if last is not None and seq <= last[0]:
                     self._last_seen[worker_id] = telemetry.now()
                     m.counter("ps_commit_dedup_total").inc()
+                    # lint: allow(blocking-call-under-lock): the dedup
+                    # decision must hit the flight log before the
+                    # cached reply escapes (acked => recorded)
                     flight_recorder.record("commit_dedup",
                                            worker=worker_id, seq=seq)
                     return unpack_params(self._center, last[1])
@@ -236,6 +240,9 @@ class HostParameterServer:
             m.histogram("ps_commit_staleness",
                         buckets=telemetry.STALENESS_BUCKETS
                         ).observe(int(staleness))
+            # lint: allow(blocking-call-under-lock): acked => durable —
+            # the commit event must be on disk before the reply leaves
+            # the lock (the warm-restart story depends on it)
             flight_recorder.record("commit", worker=worker_id, seq=seq,
                                    clock=self._clock,
                                    staleness=int(staleness))

@@ -59,6 +59,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.host_ps import (
     _NO_SEQ,
@@ -155,7 +156,7 @@ class _Shard:
 
     def __init__(self, idx: list[int], center: list[np.ndarray]):
         self.idx = idx
-        self.lock = threading.Lock()
+        self.lock = racecheck.lock("sharded_ps.shard")
         self.center = center
         self.clock = 0
         self.pull_clock: dict[int, int] = {}
@@ -196,7 +197,7 @@ class ShardedParameterServer:
         self.num_shards = len(self.plan)
         self._shards = [_Shard(idx, [leaves[i] for i in idx])
                         for idx in self.plan]
-        self._seen_lock = threading.Lock()
+        self._seen_lock = racecheck.lock("sharded_ps.seen")
         self._last_seen: dict[int, float] = {}
         self.num_snapshots = 0
         self._snapshot_path = snapshot_path
@@ -371,6 +372,9 @@ class ShardedParameterServer:
                     # one flight event per LOGICAL commit (its last
                     # shard), not one per shard — the recorder stays
                     # proportional to commits
+                    # lint: allow(blocking-call-under-lock): acked =>
+                    # durable — recorded under the last shard's lock so
+                    # no later commit can be acked first
                     flight_recorder.record(
                         "commit", worker=worker_id, seq=seq,
                         clock=s.clock, shards=self.num_shards,

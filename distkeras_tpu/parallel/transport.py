@@ -173,6 +173,80 @@ def recv_msg(sock: socket.socket) -> bytes:
     return _recvall(sock, length)
 
 
+# -- wire-op registry (ISSUE 9) ----------------------------------------
+#
+# Every single-byte command that crosses a framed socket is registered
+# here, per protocol scope, instead of living as scattered literals in
+# the dispatch/client code.  ``analysis/surfaces.py`` cross-checks the
+# literals in the wire modules against this table, so an op byte cannot
+# be added (or repurposed) without updating the registry — and the
+# registry itself rejects the two real collision hazards: two meanings
+# for one byte within a scope, and any scope reusing the trace-header
+# magic (the PS and replica servers peek one byte to tell a traced
+# frame from a bare one, so the magic must be globally unambiguous).
+
+
+class WireOpCollision(ValueError):
+    """A wire-op byte was registered twice with different meanings."""
+
+
+class WireOps:
+    """Per-scope registry of single-byte wire commands.
+
+    Scopes are independent protocols (``"ps"`` and ``"replica"`` both
+    use ``b"s"`` for stop — different servers, never ambiguous); the
+    ``"frame"`` scope holds bytes that may prefix ANY frame (the trace
+    magic) and therefore must not collide with any other scope."""
+
+    def __init__(self) -> None:
+        self._ops: dict[str, dict[bytes, str]] = {}
+
+    def register(self, scope: str, op: bytes, name: str) -> bytes:
+        if len(op) != 1:
+            raise ValueError(f"wire op must be one byte, got {op!r}")
+        table = self._ops.setdefault(scope, {})
+        if table.get(op, name) != name:
+            raise WireOpCollision(
+                f"{scope}:{op!r} already registered as "
+                f"{table[op]!r}, refusing {name!r}")
+        for other, tab in self._ops.items():
+            if other == scope:
+                continue
+            if (scope == "frame" or other == "frame") and op in tab:
+                raise WireOpCollision(
+                    f"{op!r} ({name!r} in {scope!r}) collides with "
+                    f"frame-level byte {tab[op]!r} in {other!r}")
+        table[op] = name
+        return op
+
+    def ops(self, scope: str) -> dict[bytes, str]:
+        """The registered ``op byte -> name`` table for one scope."""
+        return dict(self._ops.get(scope, {}))
+
+    def scopes(self) -> tuple[str, ...]:
+        return tuple(sorted(self._ops))
+
+
+WIRE_OPS = WireOps()
+
+# frame-level: may prefix any protocol's frames (see trace header below)
+WIRE_OPS.register("frame", b"t", "trace_header")
+# classic + sharded PS protocol (host_ps.PSServer._dispatch)
+WIRE_OPS.register("ps", b"p", "pull")
+WIRE_OPS.register("ps", b"c", "commit")
+WIRE_OPS.register("ps", b"P", "pull_since")
+WIRE_OPS.register("ps", b"C", "commit_shard")
+WIRE_OPS.register("ps", b"d", "done")
+WIRE_OPS.register("ps", b"s", "stop")
+# serving-replica protocol (gateway.ReplicaServer._dispatch)
+WIRE_OPS.register("replica", b"g", "generate")
+WIRE_OPS.register("replica", b"h", "health")
+WIRE_OPS.register("replica", b"w", "swap_weights")
+WIRE_OPS.register("replica", b"v", "variables")
+WIRE_OPS.register("replica", b"q", "quiesce")
+WIRE_OPS.register("replica", b"s", "stop")
+
+
 # -- trace-context wire header (ISSUE 6) -------------------------------
 #
 # When tracing is enabled, PS requests prepend a 17-byte header to the

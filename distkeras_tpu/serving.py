@@ -101,6 +101,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
 
@@ -413,7 +414,7 @@ class DecodeEngine:
             if n < 1:
                 raise ValueError(
                     f"bucket {env} needs >= 1 slots; got {n}")
-        self.variables = dict(variables)
+        self.variables = dict(variables)  # guarded-by: _lock
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
@@ -434,7 +435,7 @@ class DecodeEngine:
         self._prefix = (_PrefixStore(self.prefill_align,
                                      int(prefix_cache_bytes))
                         if prefix_cache_bytes is not None else None)
-        self._weights_ver = 0
+        self._weights_ver = 0  # guarded-by: _lock
         self._key = jax.random.key(seed)
         self._n_rng = 0
         self._n_submitted = 0
@@ -446,8 +447,8 @@ class DecodeEngine:
         # compiled program).  ``step()`` itself must still run on one
         # thread at a time — the gateway's ``EngineReplica`` gives
         # every engine a single driver thread by construction.
-        self._lock = threading.RLock()
-        self._closed = False
+        self._lock = racecheck.rlock("serving.engine")
+        self._closed = False  # guarded-by: _lock
         self._traces: collections.Counter = collections.Counter()
         if donate is None:
             donate = jax.default_backend() != "cpu"
@@ -749,6 +750,9 @@ class DecodeEngine:
                     and len(pool.queue) >= self.queue_bound):
                 m.counter("serving_shed_total", reason="queue_full",
                           bucket=pool.env).inc()
+                # lint: allow(blocking-call-under-lock): the shed
+                # decision and its evidence must be atomic vs a racing
+                # drain re-opening admission
                 flight_recorder.record("shed", reason="queue_full",
                                        bucket=pool.env)
                 raise ShedError(

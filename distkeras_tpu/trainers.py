@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu import mesh as mesh_lib
 from distkeras_tpu import telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import ModelSpec
 from distkeras_tpu.parallel import tensor_parallel
@@ -1610,7 +1611,7 @@ class DistributedTrainer(Trainer):
         # copy per thread); entries are dropped after the last worker
         # fetches them.
         # RLock: segment_shard -> epoch_plan nests the acquisition
-        shard_lock = threading.RLock()
+        shard_lock = racecheck.rlock("trainers.shard")
         # keyed (epoch, segment slot): one segment for in-memory
         # datasets (the whole shuffled set), one per shard file for
         # ShardedDataset — the host arm streams out-of-core data the
@@ -1629,7 +1630,7 @@ class DistributedTrainer(Trainer):
                                   - set(local_workers))
         dropped_per_epoch = [0] * self.num_epoch
         skipped_rows_per_epoch = [0] * self.num_epoch
-        accum_lock = threading.Lock()  # the two index+= arrays above
+        accum_lock = racecheck.lock("trainers.accum")  # the two index+= arrays above
 
         def _sweep_shard_cache():
             # caller holds shard_lock: drop READY entries every live

@@ -13,6 +13,7 @@ import jax
 import numpy as np
 import pytest
 
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.data import datasets
 from distkeras_tpu.models import model_config
 from distkeras_tpu.parallel import transport
@@ -23,6 +24,17 @@ jax.config.update("jax_platforms", "cpu")
 
 MLP = model_config("mlp", (8,), num_classes=4, hidden=(16,))
 DATA = datasets.synthetic_classification(1024, (8,), 4, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _racecheck():
+    """Run the whole chaos suite under the lockset race + deadlock
+    detector: every lock built during a test is instrumented, and any
+    report (race, order cycle, deadlock) fails the test."""
+    racecheck.enable()
+    yield
+    reports = racecheck.disable()
+    assert not reports, "\n".join(str(r) for r in reports)
 
 
 def test_schedule_is_a_pure_function_of_the_seed():

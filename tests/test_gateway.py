@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.gateway import (EngineReplica, RemoteReplica,
                                    ReplicaDown, ReplicaServer,
                                    ServingGateway)
@@ -25,6 +26,17 @@ from distkeras_tpu.parallel.update_rules import DownpourRule
 from distkeras_tpu.serving import DecodeEngine
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _racecheck():
+    """Gateway/replica locks are racecheck factories: run the whole
+    suite instrumented and fail on any race/order/deadlock report."""
+    racecheck.enable()
+    yield
+    reports = racecheck.disable()
+    assert not reports, "\n".join(str(r) for r in reports)
+
 
 MAXLEN, VOCAB = 32, 37
 

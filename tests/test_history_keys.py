@@ -9,12 +9,12 @@ fails on any UNDOCUMENTED emitted key; a core set is also required to
 actually appear, so the table cannot go stale silently."""
 
 import pathlib
-import re
 import time
 
 import jax
 import pytest
 
+from distkeras_tpu.analysis import surfaces
 from distkeras_tpu.data import datasets
 from distkeras_tpu.models import model_config
 from distkeras_tpu.trainers import (
@@ -34,13 +34,12 @@ DATA = datasets.synthetic_classification(512, (8,), 4, seed=0)
 
 
 def documented_keys() -> set[str]:
-    """First-column backticked keys of the history-key table."""
-    text = DOCS.read_text()
-    m = re.search(r"### Trainer history keys(.*?)(?:\n## |\Z)", text,
-                  re.S)
-    assert m, "docs/API.md lacks the 'Trainer history keys' table"
-    keys = set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
-    assert keys, "history-key table parsed empty"
+    """First-column backticked keys of the history-key table — parsed
+    by the shared ``analysis/surfaces`` extractor, the same parser
+    ``scripts/lint_static.py`` runs repo-wide."""
+    keys = surfaces.documented_history_keys(DOCS.read_text())
+    assert keys, ("docs/API.md lacks the 'Trainer history keys' table "
+                  "(or it parsed empty)")
     return keys
 
 
@@ -108,17 +107,19 @@ def _collect_emitted() -> set[str]:
 
 
 def test_serving_prefix_telemetry_keys_are_documented():
-    """ISSUE 8 extension of the lint: every prefix-cache /
-    chunked-prefill telemetry name the serving layer emits (metric
-    names, span names, the flight kind) must appear in docs/API.md —
-    grep'd from the SOURCE so a renamed emission breaks the lint, not
-    just the docs."""
+    """ISSUE 8 lint, rebuilt on the ISSUE 9 AST extractor: every
+    telemetry name the serving layer emits (metric names, span names,
+    flight kinds) must appear in docs/API.md.  The extraction is the
+    same ``analysis/surfaces`` pass ``scripts/lint_static.py`` runs
+    repo-wide, so a renamed emission breaks the lint, not just the
+    docs — and this test pins the prefix-cache core surface so the
+    extractor itself cannot silently go blind."""
     src = (DOCS.parent.parent
            / "distkeras_tpu/serving.py").read_text()
-    emitted = set(re.findall(
-        r'"(serving_prefix_[a-z_]+|serving_prefill_tokens_saved_total'
-        r'|prefix_copy|prefill_chunk|prefix_invalidate)"', src))
-    # the full surface must actually be emitted by serving.py...
+    surface = surfaces.extract_source(src, "distkeras_tpu/serving.py")
+    emitted = (set(surface.metrics) | set(surface.spans)
+               | set(surface.flight_kinds))
+    # the full prefix surface must actually be extracted...
     core = {"serving_prefix_hits_total", "serving_prefix_misses_total",
             "serving_prefix_evictions_total",
             "serving_prefix_invalidations_total",
@@ -126,16 +127,13 @@ def test_serving_prefix_telemetry_keys_are_documented():
             "serving_prefix_hit_rate", "prefix_copy", "prefill_chunk",
             "prefix_invalidate"}
     assert core <= emitted, sorted(core - emitted)
-    # ...and every emitted name must be documented
-    docs = DOCS.read_text()
-    undocumented = {k for k in emitted if k not in docs}
-    assert not undocumented, (
-        f"serving prefix telemetry keys emitted but missing from "
-        f"docs/API.md: {sorted(undocumented)}")
-    # the flight kind has a row in the kind table specifically
-    assert re.search(r"^\| `prefix_invalidate` \|", docs, re.M), (
-        "docs/API.md flight-recorder kind table lacks "
-        "`prefix_invalidate`")
+    # the flight kind is classified as a kind (table-row check), not
+    # as a loose docs word
+    assert "prefix_invalidate" in surface.flight_kinds
+    # ...and the whole serving surface must be documented (flight
+    # kinds specifically as rows of the kind table)
+    findings = surfaces.check_docs(surface, DOCS.read_text())
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 def test_every_emitted_history_key_is_documented():

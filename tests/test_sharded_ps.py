@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from distkeras_tpu import telemetry
+from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.data import datasets
 from distkeras_tpu.models import model_config
 from distkeras_tpu.parallel.host_ps import (
@@ -41,6 +42,16 @@ MLP = model_config("mlp", (8,), num_classes=4, hidden=(16,))
 DATA = datasets.synthetic_classification(1536, (8,), 4, seed=0)
 
 DELTA_RULES = [DownpourRule(), AdagRule(), DynSGDRule()]
+
+
+@pytest.fixture(autouse=True)
+def _racecheck():
+    """Shard/seen locks are racecheck factories: run the whole suite
+    instrumented and fail on any race/order/deadlock report."""
+    racecheck.enable()
+    yield
+    reports = racecheck.disable()
+    assert not reports, "\n".join(str(r) for r in reports)
 
 
 def _params(seed=0, shapes=((3, 4), (4,), (8, 2), (5,), (2, 2, 2))):
