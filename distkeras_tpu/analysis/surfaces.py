@@ -35,6 +35,7 @@ RULE_OPCODE = "unregistered-opcode"
 WIRE_SCOPES = {
     "distkeras_tpu/parallel/host_ps.py": "ps",
     "distkeras_tpu/parallel/sharded_ps.py": "ps",
+    "distkeras_tpu/parallel/replicated_ps.py": "repl",
     "distkeras_tpu/gateway.py": "replica",
     "distkeras_tpu/parallel/transport.py": "frame",
 }

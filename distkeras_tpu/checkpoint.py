@@ -102,16 +102,21 @@ def load_ps_snapshot(path: str | os.PathLike) -> Pytree:
 def ps_snapshot_info(path: str | os.PathLike) -> dict:
     """Operational peek at a PS snapshot file: which server class
     wrote it and how far it got.  Returns ``{"sharded": K or None,
-    "num_commits": int, "workers_cached": int}`` — ``sharded`` drives
-    ``PSServer.restart_from``'s dispatch (an unsharded
-    ``HostParameterServer`` snapshot has no ``"sharded"`` key; a
-    ``ShardedParameterServer`` snapshot carries the shard count plus
-    per-shard clock/dedupe sections).  ``last_acked`` maps worker id
-    (str) → highest commit seq the snapshot proves acknowledged — the
-    postmortem's cross-check key against the flight recorder (on a
-    sharded snapshot that is the MIN across shards: a logical commit
-    is acked only once its last shard replied)."""
+    "num_commits": int, "workers_cached": int, "epoch": int}`` —
+    ``sharded`` drives ``PSServer.restart_from``'s dispatch (an
+    unsharded ``HostParameterServer`` snapshot has no ``"sharded"``
+    key; a ``ShardedParameterServer`` snapshot carries the shard count
+    plus per-shard clock/dedupe sections).  ``epoch`` is the
+    replication fencing epoch the snapshot was taken under (0 when the
+    server was never part of a replica group, or predates replication)
+    — the postmortem uses it to place a snapshot on the failover
+    timeline.  ``last_acked`` maps worker id (str) → highest commit
+    seq the snapshot proves acknowledged — the postmortem's
+    cross-check key against the flight recorder (on a sharded snapshot
+    that is the MIN across shards: a logical commit is acked only once
+    its last shard replied)."""
     snap = load_ps_snapshot(path)
+    epoch = int(snap.get("epoch", 0))
     if "sharded" in snap:
         shards = snap["shards"]
         acked: dict[str, int] = {}
@@ -125,6 +130,7 @@ def ps_snapshot_info(path: str | os.PathLike) -> dict:
             "workers_cached": len({w for s in shards
                                    for w in s["last_reply"]}),
             "last_acked": acked,
+            "epoch": epoch,
         }
     return {
         "sharded": None,
@@ -132,6 +138,7 @@ def ps_snapshot_info(path: str | os.PathLike) -> dict:
         "workers_cached": len(snap["last_reply"]),
         "last_acked": {w: int(e["seq"])
                        for w, e in snap["last_reply"].items()},
+        "epoch": epoch,
     }
 
 

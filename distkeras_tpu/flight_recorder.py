@@ -6,9 +6,11 @@ after a ``PSServer.kill()`` or an engine poison, the events that
 explain the crash are exactly the ones that are gone.  This module is
 the durable sibling: rare, structured, operationally-significant
 events (commits, retries, chaos injections, snapshots, sheds, deadline
-expiries, kills, restarts, SLO state flips, and the serving gateway's
+expiries, kills, restarts, SLO state flips, the serving gateway's
 ``replica_down`` / ``failover`` / ``weight_swap`` / ``rollback``
-rollout story) are appended as JSON lines
+rollout story, and the replicated PS's ``ps_promote`` /
+``ps_fenced`` / ``ps_replica_lag`` failover story) are appended as
+JSON lines
 to a small ring of on-disk segments, so ``scripts/postmortem.py`` can
 reconstruct the last N seconds before a crash from the filesystem
 alone and cross-check it against the restarted server's state.

@@ -1080,14 +1080,36 @@ class ServingGateway:
     def _resolve_source(self, source) -> dict:
         """New weights from: a PS snapshot path, a live PS (``.center``
         — ``HostParameterServer`` / ``ShardedParameterServer``), a PS
-        client (``.pull()``), a ``{"params": ...}`` variables dict, or
-        a raw parameter pytree."""
+        client (``.pull()``), a REPLICATED PS's address list (``[(host,
+        port), ...]`` — each tried in order over the template-free
+        ``b"V"`` center fetch, so the rollout sources from whichever
+        replica currently serves; a fenced ex-primary refuses and the
+        walk moves on), a ``{"params": ...}`` variables dict, or a raw
+        parameter pytree."""
         import os
 
         if isinstance(source, (str, os.PathLike)):
             from distkeras_tpu import checkpoint
 
             params = checkpoint.ps_snapshot_center(source)
+        elif (isinstance(source, (list, tuple)) and source
+              and all(isinstance(a, (list, tuple)) and len(a) == 2
+                      for a in source)):
+            from distkeras_tpu.parallel import host_ps
+
+            last_err: Exception | None = None
+            for addr_host, addr_port in source:
+                try:
+                    obj = host_ps.fetch_center_obj(
+                        str(addr_host), int(addr_port))
+                    params = obj["center"]
+                    break
+                except (OSError, ValueError, KeyError) as e:
+                    last_err = e
+            else:
+                raise ConnectionError(
+                    f"no PS replica in {source!r} would serve the "
+                    f"center") from last_err
         elif hasattr(source, "center"):
             params = source.center
         elif hasattr(source, "pull") and callable(source.pull):

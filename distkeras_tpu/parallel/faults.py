@@ -76,6 +76,21 @@ class ChaosTransport:
         begins (``None``: never); for the next ``partition_ops``
         operations every ``connect`` raises ``ConnectionRefusedError``.
       partition_ops: width of the partition window, in operations.
+      partition_every: make the partition RECURRING: after
+        ``partition_at``, a fresh ``partition_ops``-wide window opens
+        every ``partition_every`` operations (``None``: the original
+        one-shot).  The window function is arithmetic on the op index —
+        no extra rng draws — so the schedule stays a pure function of
+        (seed, op index) and a failover drill can flap the link
+        repeatedly on an exact, reproducible cadence.
+      partition_ports: restrict the PARTITION to connects whose peer
+        port is in this set, independently of ``target_ports``
+        (``None``: the partition hits every targeted connect).  This is
+        the asymmetric-partition knob the failover drill needs: with
+        ``partition_ports={primary_worker_port}`` the worker→primary
+        hop is cut while the primary↔standby replication link (other
+        ports) stays up, so the standby observes a live primary and
+        correctly refuses to usurp.
       max_injections: hard cap on injected reset+truncate faults (so a
         seeded run provably fits a retry budget; delays and the
         partition window do not consume it — they cost time, not
@@ -97,6 +112,8 @@ class ChaosTransport:
                  delay_s: float = 0.02,
                  partition_at: Optional[int] = None,
                  partition_ops: int = 4,
+                 partition_every: Optional[int] = None,
+                 partition_ports: Optional[set] = None,
                  max_injections: Optional[int] = None,
                  skip_ops: int = 0,
                  target_ports: Optional[set] = None):
@@ -112,6 +129,16 @@ class ChaosTransport:
         self.delay_s = float(delay_s)
         self.partition_at = partition_at
         self.partition_ops = int(partition_ops)
+        if partition_every is not None and (
+                int(partition_every) <= int(partition_ops)):
+            raise ValueError(
+                f"partition_every={partition_every} must exceed "
+                f"partition_ops={partition_ops} (the link must heal "
+                f"between windows)")
+        self.partition_every = (None if partition_every is None
+                                else int(partition_every))
+        self.partition_ports = (None if partition_ports is None
+                                else {int(p) for p in partition_ports})
         self.max_injections = max_injections
         self.skip_ops = int(skip_ops)
         self.target_ports = (None if target_ports is None
@@ -151,10 +178,13 @@ class ChaosTransport:
             targeted = (self.target_ports is None
                         or (port is not None
                             and port in self.target_ports))
-            if (targeted and self.partition_at is not None
-                    and op_kind == "connect"
-                    and self.partition_at <= op
-                    < self.partition_at + self.partition_ops):
+            part_targeted = (targeted
+                             and (self.partition_ports is None
+                                  or (port is not None
+                                      and port
+                                      in self.partition_ports)))
+            if (part_targeted and op_kind == "connect"
+                    and self._in_partition_window(op)):
                 self._note("partition")
                 return "partition"
             budget_left = (self.max_injections is None
@@ -174,6 +204,17 @@ class ChaosTransport:
                     self._note(kind)
                     return kind
             return None
+
+    def _in_partition_window(self, op: int) -> bool:
+        """Pure arithmetic on the op index (NO rng): is ``op`` inside a
+        partition window?  One-shot by default; with
+        ``partition_every`` a fresh window opens on that cadence."""
+        if self.partition_at is None or op < self.partition_at:
+            return False
+        offset = op - self.partition_at
+        if self.partition_every is None:
+            return offset < self.partition_ops
+        return offset % self.partition_every < self.partition_ops
 
     # -- wrapped operations ------------------------------------------------
 

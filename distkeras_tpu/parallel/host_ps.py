@@ -113,6 +113,15 @@ Pytree = Any
 _NO_SEQ = 2 ** 64 - 1
 
 
+class PSFencedError(ConnectionError):
+    """The server refused a commit because it has been deposed: a newer
+    primary holds a higher replication epoch (``replicated_ps``).  A
+    deposed primary must reject rather than apply — two servers
+    applying commits for the same training run is a split brain.
+    Subclasses ``ConnectionError`` so ``ResilientPSClient`` treats it
+    like a dead server and fails over to the next replica address."""
+
+
 
 class HostParameterServer:
     """Threaded central state: ``pull``/``commit`` under a mutex.
@@ -167,6 +176,13 @@ class HostParameterServer:
         # param copy per worker pinned by aliasing.
         self._last_reply: dict[int, tuple[int, bytes]] = {}
         self._reply_bytes = 0
+        # replication (replicated_ps): fencing epoch stamped on the
+        # wire, the deposed flag, and the primary-side log shipper.
+        # Written rarely (attach/promotion/demotion) and read inside
+        # the commit lock; plain attributes by design.
+        self.epoch = 0
+        self._fenced = False
+        self._replicator = None
 
     # -- the two verbs -----------------------------------------------------
 
@@ -210,6 +226,10 @@ class HostParameterServer:
         # the span encloses the mutex wait, so its duration shows both
         # apply cost and serialization contention on the timeline
         with telemetry.span("ps_commit", worker=worker_id), self._lock:
+            if self._fenced:
+                raise PSFencedError(
+                    f"commit rejected: this server was deposed (a "
+                    f"newer primary holds epoch > {self.epoch})")
             if seq is not None:
                 last = self._last_reply.get(worker_id)
                 if last is not None and seq <= last[0]:
@@ -247,9 +267,20 @@ class HostParameterServer:
                                    clock=self._clock,
                                    staleness=int(staleness))
             pulled = _to_numpy(pulled)
+            reply_packed = b""
             if seq is not None:
-                self._cache_reply_locked(worker_id, seq,
-                                         pack_params(pulled))
+                reply_packed = pack_params(pulled)
+                self._cache_reply_locked(worker_id, seq, reply_packed)
+            if self._replicator is not None:
+                # inside the lock, BEFORE the reply escapes: in sync
+                # ack mode an acked commit is already on the standbys
+                # (exactly-once across failover depends on it); a
+                # fenced shipper raises here and the reply never leaves
+                self._replicator.replicate(
+                    kind="commit", worker=worker_id,
+                    payload=pack_params(payload, self._center),
+                    seq=_NO_SEQ if seq is None else int(seq),
+                    staleness=int(staleness), reply=reply_packed)
             if (self._snapshot_every
                     and self.num_commits % self._snapshot_every == 0):
                 # inside the lock, BEFORE the reply escapes: an acked
@@ -346,6 +377,67 @@ class HostParameterServer:
             return {int(w): int(seq)
                     for w, (seq, _) in self._last_reply.items()}
 
+    # -- replication (replicated_ps) --------------------------------------
+
+    def attach_replicator(self, replicator) -> None:
+        """Install the primary-side log shipper: every applied commit
+        is replayed to the standbys from inside the commit lock (sync
+        ack mode blocks the reply on the standby acks)."""
+        with self._lock:
+            self._replicator = replicator
+
+    def fence(self, epoch: int) -> None:
+        """Depose this server: a newer primary (higher ``epoch``) owns
+        the training run now.  Every later commit raises
+        ``PSFencedError`` — the client's cue to fail over."""
+        with self._lock:
+            self._fenced = True
+            self.epoch = max(self.epoch, int(epoch))
+        telemetry.metrics().counter("ps_fenced_total").inc()
+
+    def apply_replicated(self, worker_id: int, payload: bytes,
+                         seq: int | None, staleness: int,
+                         reply: bytes) -> None:
+        """Standby-side replay of one primary commit: re-runs the
+        rule's server law against the SHIPPED payload and staleness
+        (not locally derived — the standby replays the primary's
+        decisions, so its center is byte-identical) and installs the
+        primary's cached reply bytes, keeping the dedupe table
+        replicated — a client retrying across the failover boundary
+        dedupes on the promoted standby exactly as it would have on
+        the dead primary."""
+        with self._lock:
+            tree = unpack_params(self._center, payload)
+            state = PSState(center=self._center,
+                            clock=np.int32(self._clock))
+            new_state = self.rule.commit(state, tree,
+                                         np.int32(staleness))
+            self._center = _to_numpy(new_state.center)
+            self._clock += 1
+            self._pull_clock[worker_id] = self._clock
+            self.staleness_log.append(int(staleness))
+            if len(self.staleness_log) > \
+                    self.STALENESS_LOG_WINDOW * 5 // 4:
+                del self.staleness_log[:-self.STALENESS_LOG_WINDOW]
+            self.num_commits += 1
+            if seq is not None:
+                self._cache_reply_locked(worker_id, int(seq),
+                                         bytes(reply))
+            if (self._snapshot_every
+                    and self.num_commits % self._snapshot_every == 0):
+                self._write_snapshot_locked()
+
+    def replication_snapshot(self, head_fn) -> tuple[int, dict]:
+        """A ``(replication-log head seq, snapshot dict)`` pair that is
+        CONSISTENT: both are read under the commit lock, where every
+        log-seq assignment also happens, so the snapshot contains
+        exactly the commits through ``head`` — the correctness
+        condition for bootstrapping a standby (``head_fn`` is the
+        replicator's ``head_seq``; lock order stays PS -> replicator,
+        same as the in-commit ship path)."""
+        with self._lock:
+            return int(head_fn()), self._snapshot_locked()
+
     # -- snapshot / warm restart ------------------------------------------
 
     def _snapshot_locked(self) -> dict:
@@ -353,6 +445,7 @@ class HostParameterServer:
         # references are a consistent point-in-time copy under the lock
         return {
             "center": self._center,
+            "epoch": self.epoch,
             "clock": self._clock,
             "num_commits": self.num_commits,
             "pull_clock": {str(w): c
@@ -415,6 +508,7 @@ class HostParameterServer:
                 "sharded_ps.ShardedParameterServer.from_snapshot")
         ps = cls(rule, snapshot["center"], snapshot_path=snapshot_path,
                  snapshot_every=snapshot_every)
+        ps.epoch = int(snapshot.get("epoch", 0))
         ps._clock = int(snapshot["clock"])
         ps.num_commits = int(snapshot["num_commits"])
         ps._pull_clock = {int(w): int(c) for w, c
@@ -442,7 +536,8 @@ class PSServer:
     """
 
     def __init__(self, ps, template: Pytree,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 sock: socket.socket | None = None):
         """``ps`` is a ``HostParameterServer`` or a
         ``sharded_ps.ShardedParameterServer`` — the latter additionally
         serves the shard-addressed ops ``b"P"`` (version-delta pull)
@@ -465,9 +560,16 @@ class PSServer:
             tleaves = jax.tree_util.tree_leaves(self._template)
             self._shard_templates = [[tleaves[i] for i in idx]
                                      for idx in ps.plan]
-        self._sock = socket.socket()
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        if sock is not None:
+            # a pre-bound (not yet listening) socket: replicated_ps
+            # reserves each replica's advertised worker port at
+            # construction and hands it over at promotion time
+            self._sock = sock
+        else:
+            self._sock = socket.socket()
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
         self._sock.listen()
         self.address = self._sock.getsockname()
         self._threads: list[threading.Thread] = []
@@ -481,8 +583,13 @@ class PSServer:
         return self
 
     def _accept_loop(self):
-        self._sock.settimeout(0.2)
         try:
+            # inside the try: kill() may close the socket before this
+            # thread gets scheduled, and that race must not traceback
+            try:
+                self._sock.settimeout(0.2)
+            except OSError:
+                return
             while not self._stop.is_set():
                 try:
                     conn, _ = self._sock.accept()
@@ -546,6 +653,14 @@ class PSServer:
                                        body, rx, tx)
                         if self._stop.is_set():
                             return
+            except PSFencedError as e:
+                # deposed primary: refuse the commit and drop the
+                # connection — the client's ConnectionError sends it
+                # to the next replica address.  Recorded (not printed):
+                # fencing is the protocol working, not a handler bug.
+                flight_recorder.record("ps_fenced", worker=worker_id,
+                                       detail=str(e))
+                return
             except (ConnectionError, OSError):
                 return  # client gone; reference handlers did the same
             except Exception as e:
@@ -625,6 +740,25 @@ class PSServer:
             tx.inc(transport.send_msg_gather(
                 conn, clock.to_bytes(8, "big"),
                 *leaf_buffers(pulled, temps)))
+        elif cmd == b"E":
+            # replication epoch probe: 8-byte big-endian epoch (0 for
+            # an unreplicated server) — lets trainers record which
+            # epoch served the run and clients spot a deposed primary
+            wire = int(getattr(self.ps, "epoch", 0)).to_bytes(8, "big")
+            tx.inc(len(wire))
+            transport.send_msg(conn, wire)
+        elif cmd == b"V":
+            # template-free center fetch (msgpack): the gateway's
+            # rolling_update(source=[(host, port), ...]) pulls promoted
+            # weights without holding the training template
+            wire = transport.pack_obj({
+                "center": jax.tree_util.tree_map(
+                    np.asarray, self.ps.center),
+                "epoch": int(getattr(self.ps, "epoch", 0)),
+                "num_commits": int(getattr(self.ps, "num_commits", 0)),
+            })
+            tx.inc(len(wire))
+            transport.send_msg(conn, wire)
         elif cmd == b"d":
             # clean worker finish: retire from liveness monitoring and
             # drop its dedupe reply
@@ -838,6 +972,65 @@ class _InProcessClient:
         pass
 
 
+class _ReplicaCycler:
+    """Ordered replica address list with probe-before-declare-dead
+    (mirroring ``gateway.RemoteReplica.probe``): the client sticks to
+    its current address until a connect fails AND a cheap probe agrees
+    the address is dead, then advances to the next replica — so a
+    transient fault (a chaos-injected reset on a healthy primary)
+    retries in place instead of stampeding the standby, while a killed
+    primary fails over within one retry.  Wraps around: an unpromoted
+    standby refuses connects (its worker port is reserved but not yet
+    listening), so the cycle keeps walking until promotion finishes."""
+
+    def __init__(self, addresses, *, probe_timeout: float = 0.25,
+                 worker: int | None = None):
+        addrs = [(str(h), int(p)) for h, p in addresses]
+        if not addrs:
+            raise ValueError("ps_replicas needs at least one address")
+        self.addresses = addrs
+        self.probe_timeout = float(probe_timeout)
+        self.worker = worker
+        self.failovers = 0  # guarded-by: _lock
+        self._i = 0  # guarded-by: _lock
+        self._lock = racecheck.lock("ps_replica_cycler")
+
+    def current(self) -> tuple[str, int]:
+        with self._lock:
+            return self.addresses[self._i]
+
+    def _probe(self, host: str, port: int) -> bool:
+        """Is anything still accepting on (host, port)?  A bare TCP
+        connect is the PS wire's health check — the server speaks only
+        after the client's hello, so an accepted connect IS liveness."""
+        try:
+            transport.connect(host, port,
+                              timeout=self.probe_timeout).close()
+            return True
+        except OSError:
+            return False
+
+    def connect(self, build: Callable[[str, int], Any]):
+        """Build a client against the current address; on failure,
+        probe before declaring the replica dead and advancing."""
+        host, port = self.current()
+        try:
+            return build(host, port)
+        except Exception:
+            if not self._probe(host, port):
+                with self._lock:
+                    # another thread may have advanced first; only
+                    # count a failover if we still point at the dead
+                    # address (workers share one cycle position per
+                    # client, not a global one)
+                    if self.addresses[self._i] == (host, port):
+                        self._i = (self._i + 1) % len(self.addresses)
+                        self.failovers += 1
+                telemetry.metrics().counter(
+                    "ps_client_failovers_total").inc()
+            raise
+
+
 class ResilientPSClient:
     """Self-healing PS client: reconnect + exponential backoff with
     deterministic jitter + an explicit retry budget + at-most-once
@@ -865,15 +1058,29 @@ class ResilientPSClient:
                  backoff_base: float = 0.05, backoff_max: float = 2.0,
                  jitter: float = 0.5, seed: int = 0,
                  use_seq: bool = True,
+                 retry_deadline: float | None = None,
                  on_retry: Optional[Callable[[int, Exception], None]]
                  = None, worker: int | None = None):
+        """``retry_deadline`` (seconds, wall clock) bounds each
+        operation's WHOLE retry ladder alongside the attempt-count
+        budget: a generous ``retries`` with exponential backoff can
+        otherwise stall a worker for the full ladder even after
+        failover has already produced a live server elsewhere.  Either
+        budget tripping raises ``PSRetryExhausted`` (the message says
+        which)."""
         if retries < 0:
             raise ValueError(f"retries must be >= 0, got {retries}")
         if not 0.0 <= jitter <= 1.0:
             raise ValueError(f"jitter={jitter} outside [0, 1]")
+        if retry_deadline is not None and retry_deadline <= 0:
+            raise ValueError(
+                f"retry_deadline must be > 0 seconds, got "
+                f"{retry_deadline}")
         self.worker = worker  # identity for traces / flight records
         self._factory = factory
         self.retries = int(retries)
+        self.retry_deadline = (None if retry_deadline is None
+                               else float(retry_deadline))
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
         self.jitter = float(jitter)
@@ -910,6 +1117,41 @@ class ResilientPSClient:
                    **kwargs)
 
     @classmethod
+    def for_replicas(cls, addresses, *, worker_id: int,
+                     template: Pytree, codec=None, shards: int = 1,
+                     shard_stats: dict | None = None,
+                     probe_timeout: float = 0.25, **kwargs
+                     ) -> "ResilientPSClient":
+        """Multi-address socket arm for a replicated PS
+        (``replicated_ps``): ``addresses`` is the ORDERED replica list
+        — the same order every replica holds, which is also the
+        promotion tie-break order.  The client walks it through a
+        ``_ReplicaCycler`` (probe-before-declare-dead), so a primary
+        kill mid-training fails over transparently: the commit retry
+        lands on the promoted standby, whose replicated dedupe table
+        makes the retry exactly-once.  The cycler is exposed as
+        ``.replicas`` (``.failovers`` feeds trainer history)."""
+        kwargs.setdefault("worker", worker_id)
+        cycler = _ReplicaCycler(addresses, probe_timeout=probe_timeout,
+                                worker=worker_id)
+        if shards > 1:
+            from distkeras_tpu.parallel.sharded_ps import (
+                ShardedPSClient)
+
+            def build(host, port):
+                return ShardedPSClient(
+                    host, port, worker_id=worker_id,
+                    template=template, num_shards=shards, codec=codec,
+                    stats=shard_stats)
+        else:
+            def build(host, port):
+                return PSClient(host, port, worker_id=worker_id,
+                                template=template, codec=codec)
+        client = cls(lambda: cycler.connect(build), **kwargs)
+        client.replicas = cycler
+        return client
+
+    @classmethod
     def for_server(cls, ps: HostParameterServer, worker_id: int,
                    **kwargs) -> "ResilientPSClient":
         """In-process arm.  Commits there are atomic (apply-and-reply
@@ -942,6 +1184,8 @@ class ResilientPSClient:
             kind: str = "op") -> Pytree:
         attempt = 0
         m = telemetry.metrics()
+        deadline = (None if self.retry_deadline is None
+                    else telemetry.now() + self.retry_deadline)
         # one span over the WHOLE retry loop: every attempt's
         # ps_client_commit/pull span nests under it and inherits its
         # trace id, so a retry storm reads as one causal chain in the
@@ -966,11 +1210,24 @@ class ResilientPSClient:
                     if attempt > self.retries:
                         raise PSRetryExhausted(
                             f"PS operation failed {attempt} time(s); "
-                            f"retry budget {self.retries} exhausted "
+                            f"retry budget retries={self.retries} "
+                            f"(attempt count) exhausted "
                             f"(last: {e!r})") from e
+                    remaining = (None if deadline is None
+                                 else deadline - telemetry.now())
+                    if remaining is not None and remaining <= 0:
+                        raise PSRetryExhausted(
+                            f"PS operation failed {attempt} time(s); "
+                            f"retry budget retry_deadline="
+                            f"{self.retry_deadline}s (wall clock) "
+                            f"exhausted (last: {e!r})") from e
                     if self.on_retry is not None:
                         self.on_retry(attempt, e)
                     delay = self._backoff_delay(attempt)
+                    if remaining is not None:
+                        # never sleep past the wall-clock budget: the
+                        # last attempt before the deadline still runs
+                        delay = min(delay, remaining)
                     m.histogram(
                         "ps_client_backoff_seconds").observe(delay)
                     time.sleep(delay)
@@ -1019,3 +1276,39 @@ def stop_server(host: str, port: int):
         transport.send_msg(sock, b"s")
     finally:
         sock.close()
+
+
+#: hello worker id used by management probes (epoch / center fetch) —
+#: outside any trainer's worker-id range, never registered for liveness
+_PROBE_WORKER = 2 ** 32 - 1
+
+
+def fetch_epoch(host: str, port: int, timeout: float = 10.0) -> int:
+    """The server's replication epoch (0 when unreplicated) via the
+    ``b"E"`` wire verb — how trainers record ``ps_epoch`` history and
+    tools identify which replica currently answers an address."""
+    sock = transport.connect(host, port, timeout=timeout)
+    try:
+        transport.send_msg(sock, _PROBE_WORKER.to_bytes(4, "big"))
+        transport.send_msg(sock, b"E")
+        return int.from_bytes(transport.recv_msg(sock), "big")
+    finally:
+        sock.close()
+
+
+def fetch_center_obj(host: str, port: int,
+                     timeout: float = 30.0) -> dict:
+    """Template-free center fetch via the ``b"V"`` wire verb: returns
+    ``{"center": pytree, "epoch": int, "num_commits": int}``.  The
+    serving gateway's ``rolling_update(source=[(host, port), ...])``
+    uses this to pull promoted weights from whichever replica of a
+    training PS is alive."""
+    sock = transport.connect(host, port, timeout=timeout)
+    try:
+        transport.send_msg(sock, _PROBE_WORKER.to_bytes(4, "big"))
+        transport.send_msg(sock, b"V")
+        obj = transport.unpack_obj(transport.recv_msg(sock))
+    finally:
+        sock.close()
+    return {"center": obj["center"], "epoch": int(obj["epoch"]),
+            "num_commits": int(obj["num_commits"])}

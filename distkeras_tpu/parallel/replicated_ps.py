@@ -1,0 +1,976 @@
+"""Replicated parameter server: hot standby, automatic failover, and
+epoch fencing (ISSUE 10 tentpole).
+
+The training PS was the last single point of failure: snapshots +
+``PSServer.restart_from`` recover state but need an OPERATOR to bring
+a server back, while the serving tier already fails over by itself
+(``gateway``).  This module closes that gap with primary/standby
+replication in the spirit of Li et al.'s parameter-server replication
+and the bounded-staleness recovery argument of SSP/Petuum:
+
+* **Log shipping.**  The primary ships its commit log — seq-ordered
+  applied payloads plus dedupe-table updates, per-shard for the
+  sharded server — to N standbys over ``WIRE_OPS``-registered opcodes
+  on the existing ``transport`` framing (scope ``"repl"``: requests
+  ``a``/``h``/``?``/``b``, replies ``k``/``f``/``g``).  Each entry
+  carries the payload bytes, the staleness the primary derived, and
+  the primary's packed reply, so a standby's replay reconstructs the
+  center, the clocks AND the commit-seq dedupe table byte-identically
+  — which is what makes a client retry across the failover boundary
+  exactly-once.
+* **Sync / async ack.**  ``mode="sync"`` ships from inside the commit
+  lock: a commit's reply cannot escape to the worker before every
+  reachable standby acked it.  ``mode="async"`` appends and lets the
+  shipper thread drain — lower commit latency, but a primary crash can
+  lose the unshipped tail (the client's retry re-applies it on the
+  promoted standby; still at-most-once, no longer exactly-once).
+  Standby lag is surfaced as the ``ps_standby_lag`` gauge and flagged
+  as a ``ps_replica_lag`` flight event when it crosses ``max_lag``.
+* **Epoch fencing.**  Every promotion bumps a fencing epoch stamped on
+  the replication wire.  A standby rejects log entries below its epoch
+  with the ``f`` reply; a deposed primary that comes back is fenced —
+  its commits raise ``PSFencedError`` instead of splitting the brain —
+  and is later re-absorbed as a standby via a full bootstrap.
+* **Deterministic promotion.**  A standby that loses contact with the
+  primary for ``failover_timeout`` probes every peer before declaring
+  the primary dead (mirroring ``gateway.RemoteReplica.probe``), then
+  the winner is the highest ``(epoch, last_applied_seq)`` with ties
+  broken by address order (``elect`` — a pure function every replica
+  evaluates identically).  The winner starts serving workers on its
+  pre-reserved, advertised port — no operator action.
+
+``ResilientPSClient.for_replicas`` (``host_ps``) is the worker-side
+arm: an ordered replica list walked with probe-before-declare-dead, so
+training continues through a primary kill with the retried commit
+deduped on the promoted standby.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any, Optional, Sequence
+
+from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.parallel.host_ps import (
+    _NO_SEQ,
+    _to_numpy,
+    HostParameterServer,
+    PSFencedError,
+    PSServer,
+)
+from distkeras_tpu.parallel.update_rules import UpdateRule
+
+Pytree = Any
+
+#: gap-reply sentinel: "my state cannot chain onto your log — send a
+#: full bootstrap" (log seqs start at 1, so 0 is never a real position)
+_BOOTSTRAP_ME = 0
+
+
+def elect(candidates: Sequence[tuple[int, int, int]]) -> int:
+    """Deterministic promotion: each candidate is ``(epoch,
+    last_applied_seq, address_index)``; the highest ``(epoch,
+    last_applied_seq)`` wins, ties broken by ADDRESS ORDER (the lowest
+    index).  Every replica evaluates the same pure function over
+    whatever candidate set it can reach, so concurrent elections over
+    the same reachable set agree — and disagreement (a partition)
+    resolves by epoch fencing, not by both winners serving."""
+    if not candidates:
+        raise ValueError("election needs at least one candidate")
+    best = max(candidates,
+               key=lambda c: (int(c[0]), int(c[1]), -int(c[2])))
+    return int(best[2])
+
+
+def _ps_from_snapshot(rule: UpdateRule, snapshot: dict, *,
+                      snapshot_path=None, snapshot_every: int = 0):
+    """Restore the right server class from a snapshot dict (the same
+    ``"sharded"``-key dispatch as ``PSServer.restart_from``, minus the
+    server start)."""
+    if "sharded" in snapshot:
+        from distkeras_tpu.parallel.sharded_ps import (
+            ShardedParameterServer)
+
+        return ShardedParameterServer.from_snapshot(
+            rule, snapshot, snapshot_path=snapshot_path,
+            snapshot_every=snapshot_every)
+    return HostParameterServer.from_snapshot(
+        rule, snapshot, snapshot_path=snapshot_path,
+        snapshot_every=snapshot_every)
+
+
+def query_status(addr: tuple[str, int],
+                 timeout: float = 0.5) -> Optional[dict]:
+    """One replica's replication status via the ``?`` wire verb —
+    ``{"epoch", "last_applied", "role", "index"}`` — or ``None`` if the
+    replica is unreachable.  This is both the election's
+    probe-before-declare-dead and the operator's peek."""
+    try:
+        sock = transport.connect(addr[0], addr[1], timeout=timeout)
+    except OSError:
+        return None
+    try:
+        sock.settimeout(timeout)
+        transport.send_msg(sock, b"?")
+        obj = transport.unpack_obj(transport.recv_msg(sock))
+        return {"epoch": int(obj["epoch"]),
+                "last_applied": int(obj["last_applied"]),
+                "role": str(obj["role"]),
+                "index": int(obj.get("index", -1))}
+    except (OSError, ValueError, KeyError):
+        return None
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+class _Link:
+    """One standby's shipping state, owned by the replicator lock."""
+
+    __slots__ = ("addr", "sock", "acked", "alive", "needs_bootstrap",
+                 "last_error")
+
+    def __init__(self, addr: tuple[str, int], acked: int):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.sock: Optional[socket.socket] = None
+        self.acked = int(acked)
+        self.alive = True  # optimistic; first failed ship downs it
+        self.needs_bootstrap = False
+        self.last_error: Optional[str] = None
+
+
+class Replicator:
+    """Primary-side commit-log shipper.
+
+    ``replicate(**entry)`` is called by the parameter server from
+    INSIDE its commit lock (``HostParameterServer.commit`` /
+    ``ShardedParameterServer.commit_shard``): the entry is appended to
+    the bounded in-memory log under the replicator lock and — in sync
+    mode — shipped to every live standby before the call returns, so
+    an acked commit is already replicated.  A standby replying
+    ``fenced`` (it saw a higher epoch) raises ``PSFencedError`` out of
+    the commit: the deposed primary refuses the commit rather than
+    split the brain; the node monitor sees ``.fenced`` and demotes.
+
+    A maintenance thread (``start()``) heartbeats idle standbys,
+    revives downed links, drains the async backlog, and
+    full-bootstraps standbys that cannot chain onto the bounded log
+    (consistent snapshot + resubscribe).  Lock order everywhere: PS
+    commit lock (when held) -> replicator lock; the bootstrap path
+    takes the PS lock first (``ps.replication_snapshot``) and only
+    then the replicator lock — never the reverse.
+    """
+
+    def __init__(self, ps, standbys: Sequence[tuple[str, int]], *,
+                 epoch: int, mode: str = "sync", start_seq: int = 1,
+                 ack_timeout: float = 5.0, heartbeat_s: float = 0.25,
+                 max_lag: int = 64, max_log: int = 1024):
+        if mode not in ("sync", "async"):
+            raise ValueError(
+                f"mode must be 'sync' or 'async', got {mode!r}")
+        self._ps = ps
+        self.epoch = int(epoch)
+        self.mode = mode
+        self.ack_timeout = float(ack_timeout)
+        self.heartbeat_s = float(heartbeat_s)
+        self.max_lag = int(max_lag)
+        self.max_log = int(max_log)
+        self.fenced = False  # read lock-free by the node monitor
+        self.newer_epoch = int(epoch)
+        self._lock = racecheck.lock("replicated_ps.replicator")
+        self._next_seq = int(start_seq)  # guarded-by: _lock
+        self._log: list[tuple[int, bytes]] = []  # guarded-by: _lock
+        self._links = [_Link(a, start_seq - 1) for a in standbys]
+        self._lag_flagged = False  # guarded-by: _lock
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the hot path (called under the PS commit lock) ----------------
+
+    def replicate(self, **entry) -> None:
+        """Append one commit-log entry and (sync mode) ship it.  Raises
+        ``PSFencedError`` if this primary has been deposed — the
+        caller's commit must fail, not ack."""
+        data = transport.pack_obj(dict(entry))
+        with telemetry.span("ps_replicate", mode=self.mode), \
+                self._lock:
+            if self.fenced:
+                raise self._fenced_error()
+            seq = self._next_seq
+            self._next_seq += 1
+            self._log.append((seq, data))
+            if len(self._log) > self.max_log:
+                del self._log[:len(self._log) - self.max_log]
+            telemetry.metrics().counter(
+                "ps_replicated_entries_total").inc()
+            if self.mode == "sync":
+                self._ship_all_locked()
+            self._update_lag_locked()
+        self._wake.set()
+
+    def head_seq(self) -> int:
+        """The last assigned log seq.  A caller holding the PS commit
+        lock(s) (``replication_snapshot``) reads a value exactly
+        consistent with the PS state: every entry is assigned under
+        that lock."""
+        with self._lock:
+            return self._next_seq - 1
+
+    def acked_seqs(self) -> dict[tuple[str, int], int]:
+        """Per-standby last acked log seq (chaos drills assert the
+        promoted standby acked everything the dead primary acked)."""
+        with self._lock:
+            return {link.addr: int(link.acked)
+                    for link in self._links}
+
+    # -- shipping (all under self._lock) -------------------------------
+
+    def _fenced_error(self) -> PSFencedError:
+        err = PSFencedError(
+            f"primary at epoch {self.epoch} fenced: a standby holds "
+            f"epoch {self.newer_epoch}")
+        err.newer_epoch = self.newer_epoch
+        return err
+
+    def _fence_locked(self, their_epoch: int) -> PSFencedError:
+        self.fenced = True
+        self.newer_epoch = max(self.newer_epoch, int(their_epoch))
+        telemetry.metrics().counter("ps_fenced_total").inc()
+        # lint: allow(blocking-call-under-lock): the fencing decision
+        # must hit the flight log before any caller observes it — this
+        # is the split-brain postmortem's key event
+        flight_recorder.record("ps_fenced", role="primary",
+                               epoch=self.epoch,
+                               newer_epoch=int(their_epoch))
+        flight_recorder.flush()
+        return self._fenced_error()
+
+    def _log_entry_locked(self, seq: int) -> Optional[bytes]:
+        if not self._log or seq < self._log[0][0]:
+            return None
+        data_seq, data = self._log[seq - self._log[0][0]]
+        if data_seq != seq:  # defensive: the log must be contiguous
+            raise AssertionError(
+                f"replication log skew: wanted {seq}, found "
+                f"{data_seq}")
+        return data
+
+    def _ensure_sock_locked(self, link: _Link) -> None:
+        if link.sock is None:
+            # lint: allow(blocking-call-under-lock): sync ack mode —
+            # the commit's reply must not escape before the standbys
+            # ack, so the ship (connect included) happens under the
+            # lock by design; ack_timeout bounds the stall
+            link.sock = transport.connect(
+                link.addr[0], link.addr[1], timeout=self.ack_timeout)
+            link.sock.settimeout(self.ack_timeout)
+
+    def _mark_down_locked(self, link: _Link, exc: Exception) -> None:
+        link.alive = False
+        link.last_error = repr(exc)
+        if link.sock is not None:
+            try:
+                link.sock.close()
+            except OSError:
+                pass
+            link.sock = None
+        telemetry.metrics().counter("ps_standby_down_total").inc()
+
+    def _handle_reply_locked(self, link: _Link, reply: bytes) -> None:
+        tag, value = bytes(reply[:1]), int.from_bytes(reply[1:9],
+                                                      "big")
+        if tag == b"k":
+            link.acked = max(link.acked, value)
+        elif tag == b"f":
+            raise self._fence_locked(value)
+        elif tag == b"g":
+            head = self._next_seq - 1
+            log_start = (self._log[0][0] if self._log
+                         else self._next_seq)
+            if (value == _BOOTSTRAP_ME or value > head + 1
+                    or value < log_start):
+                # the standby cannot chain onto our log (diverged,
+                # ahead of us, or behind the bounded window): full
+                # snapshot next maintenance tick
+                link.needs_bootstrap = True
+            else:
+                link.acked = value - 1
+        else:
+            raise ConnectionError(f"bad replication ack {tag!r}")
+
+    def _service_link_locked(self, link: _Link,
+                             heartbeat: bool) -> None:
+        """Ship every pending entry to one standby, then (optionally)
+        a heartbeat; any wire failure downs the link."""
+        try:
+            self._ensure_sock_locked(link)
+            guard = 0
+            while link.acked < self._next_seq - 1 \
+                    and not link.needs_bootstrap:
+                seq = link.acked + 1
+                data = self._log_entry_locked(seq)
+                if data is None:
+                    link.needs_bootstrap = True
+                    break
+                # lint: allow(blocking-call-under-lock): sync ack mode
+                # ships inside the commit lock by design (see
+                # _ensure_sock_locked); ack_timeout bounds the stall
+                transport.send_msg(
+                    link.sock,
+                    b"a" + self.epoch.to_bytes(8, "big")
+                    + seq.to_bytes(8, "big"), data)
+                # lint: allow(blocking-call-under-lock): same contract
+                reply = transport.recv_msg(link.sock)
+                self._handle_reply_locked(link, reply)
+                guard += 1
+                if guard > 2 * self.max_log:  # repeated gap replies
+                    raise ConnectionError(
+                        "standby not converging (gap loop)")
+            if heartbeat and not link.needs_bootstrap:
+                head = self._next_seq - 1
+                # lint: allow(blocking-call-under-lock): heartbeat on
+                # the maintenance thread; ack_timeout bounds the stall
+                transport.send_msg(
+                    link.sock,
+                    b"h" + self.epoch.to_bytes(8, "big")
+                    + head.to_bytes(8, "big"))
+                # lint: allow(blocking-call-under-lock): same contract
+                reply = transport.recv_msg(link.sock)
+                self._handle_reply_locked(link, reply)
+        except PSFencedError:
+            raise
+        except (OSError, ValueError, ConnectionError) as e:
+            self._mark_down_locked(link, e)
+
+    def _ship_all_locked(self) -> None:
+        for link in self._links:
+            if link.alive and not link.needs_bootstrap:
+                self._service_link_locked(link, heartbeat=False)
+
+    def _update_lag_locked(self) -> None:
+        head = self._next_seq - 1
+        lag = head - min((link.acked for link in self._links),
+                         default=head)
+        telemetry.metrics().gauge("ps_standby_lag").set(lag)
+        if lag > self.max_lag and not self._lag_flagged:
+            self._lag_flagged = True
+            # lint: allow(blocking-call-under-lock): edge-triggered
+            # (once per breach) — the lag breach must reach the flight
+            # log even if the primary dies right after
+            flight_recorder.record("ps_replica_lag", lag=int(lag),
+                                   head=int(head),
+                                   max_lag=self.max_lag)
+        elif lag <= self.max_lag // 2:
+            self._lag_flagged = False
+
+    # -- maintenance thread --------------------------------------------
+
+    def start(self) -> "Replicator":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._maintain_loop,
+                name="ps-replicator", daemon=True)
+            self._thread.start()
+        return self
+
+    def _maintain_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            self._wake.wait(self.heartbeat_s)
+            self._wake.clear()
+            if self._stop_evt.is_set() or self.fenced:
+                return
+            try:
+                self._tick()
+            except PSFencedError:
+                return  # the node monitor sees .fenced and demotes
+            except Exception:
+                continue  # a sick standby must not kill maintenance
+
+    def _tick(self) -> None:
+        # bootstraps first, OUTSIDE the replicator lock: the snapshot
+        # takes the PS lock, and lock order is PS -> replicator
+        with self._lock:
+            need = [link for link in self._links
+                    if link.needs_bootstrap]
+        for link in need:
+            self._bootstrap_link(link)
+        with self._lock:
+            for link in self._links:
+                if not link.alive:
+                    # optimistic revive: the next ship either works or
+                    # downs it again; position is re-learned from the
+                    # standby's gap/ack replies, so a standby that came
+                    # back on its own schedule just catches up
+                    link.alive = True
+                    self._service_link_locked(link, heartbeat=True)
+                elif not link.needs_bootstrap:
+                    self._service_link_locked(link, heartbeat=True)
+            self._update_lag_locked()
+
+    def _bootstrap_link(self, link: _Link) -> None:
+        """Full-state resync of one standby: a consistent (log head,
+        snapshot) pair from the PS — read under the PS commit lock(s),
+        where no commit can interleave between the state copy and the
+        head read — shipped as one ``b`` frame."""
+        head, snap = self._ps.replication_snapshot(self.head_seq)
+        data = transport.pack_obj(snap)
+        with self._lock:
+            if self.fenced:
+                return
+            try:
+                self._ensure_sock_locked(link)
+                # lint: allow(blocking-call-under-lock): bootstrap is
+                # rare (standby restart) and bounded by ack_timeout
+                transport.send_msg(
+                    link.sock,
+                    b"b" + self.epoch.to_bytes(8, "big")
+                    + head.to_bytes(8, "big"), data)
+                # lint: allow(blocking-call-under-lock): same contract
+                reply = transport.recv_msg(link.sock)
+                self._handle_reply_locked(link, reply)
+                link.needs_bootstrap = False
+                link.alive = True
+                telemetry.metrics().counter(
+                    "ps_standby_bootstraps_total").inc()
+            except PSFencedError:
+                raise
+            except (OSError, ValueError, ConnectionError) as e:
+                self._mark_down_locked(link, e)
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        self._wake.set()
+        with self._lock:
+            for link in self._links:
+                if link.sock is not None:
+                    try:
+                        link.sock.close()
+                    except OSError:
+                        pass
+                    link.sock = None
+
+
+class PSReplica:
+    """One replica of a replicated training PS — a SYMMETRIC node:
+    every replica runs the replication listener, holds an inner
+    parameter server (``HostParameterServer``, or
+    ``ShardedParameterServer`` when ``num_shards > 1``) and RESERVES
+    its advertised worker port (bound but not listening, so worker
+    connects are refused until promotion).  The current primary
+    additionally runs a worker-facing ``PSServer`` on that reserved
+    socket plus a ``Replicator``; standbys replay the shipped log and
+    watch the primary's heartbeats, electing a successor (``elect``)
+    when it goes quiet.
+
+    Roles are dynamic: promotion bumps the fencing epoch
+    (``ps_promote`` flight event, ``ps_promotions_total`` counter); a
+    deposed primary demotes in place (``ps_fenced``), its state rewound
+    by a full bootstrap from the new primary before it rejoins the
+    standby set.
+    """
+
+    def __init__(self, rule: UpdateRule, center: Pytree, *,
+                 num_shards: int = 1, host: str = "127.0.0.1",
+                 worker_port: int = 0, repl_port: int = 0,
+                 snapshot_path: str | os.PathLike | None = None,
+                 snapshot_every: int = 0, mode: str = "sync",
+                 ack_timeout: float = 5.0, max_lag: int = 64,
+                 failover_timeout: float = 1.0,
+                 heartbeat_s: float | None = None,
+                 probe_timeout: float = 0.25):
+        """``failover_timeout`` is the standby's silence threshold
+        before it opens an election; ``heartbeat_s`` (default a quarter
+        of it — it must be well under) paces the primary's idle
+        heartbeats, so a healthy-but-idle primary is never deposed.
+        ``mode``/``ack_timeout``/``max_lag`` parameterize the
+        ``Replicator`` this node builds when promoted."""
+        if heartbeat_s is None:
+            heartbeat_s = float(failover_timeout) / 4.0
+        if heartbeat_s >= failover_timeout:
+            raise ValueError(
+                f"heartbeat_s={heartbeat_s} must be < "
+                f"failover_timeout={failover_timeout} (a healthy "
+                f"primary must heartbeat faster than standbys give "
+                f"up on it)")
+        self.rule = rule
+        self._template = _to_numpy(center)
+        self.num_shards = int(num_shards)
+        self._snapshot_path = snapshot_path
+        self._snapshot_every = int(snapshot_every)
+        self.mode = mode
+        self.ack_timeout = float(ack_timeout)
+        self.max_lag = int(max_lag)
+        self.failover_timeout = float(failover_timeout)
+        self.heartbeat_s = float(heartbeat_s)
+        self.probe_timeout = float(probe_timeout)
+        self.ps = self._build_ps(center)
+        # reserve the ADVERTISED worker port now: bound but not
+        # listening, so a worker's connect is refused (not hung) until
+        # this node is promoted and hands the socket to a PSServer
+        self._worker_sock = self._bind(host, worker_port)
+        self.worker_address = self._worker_sock.getsockname()
+        self._repl_sock = self._bind(host, repl_port)
+        self._repl_sock.listen()
+        self.repl_address = self._repl_sock.getsockname()
+        self._lock = racecheck.lock("replicated_ps.node")
+        self.role = "standby"  # guarded-by: _lock
+        self.index = 0  # position in the shared address order
+        self.peers: list[dict] = []  # guarded-by: _lock
+        self.last_applied = 0  # guarded-by: _lock
+        self._diverged = False  # guarded-by: _lock (ex-primary state)
+        self._last_contact = telemetry.now()  # guarded-by: _lock
+        self.server: Optional[PSServer] = None  # guarded-by: _lock
+        self.replicator: Optional[Replicator] = None  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._started = False
+        self._repl_conns: list[socket.socket] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ps-repl-accept",
+            daemon=True)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="ps-repl-monitor",
+            daemon=True)
+
+    def _build_ps(self, center: Pytree):
+        if self.num_shards > 1:
+            from distkeras_tpu.parallel.sharded_ps import (
+                ShardedParameterServer)
+
+            return ShardedParameterServer(
+                self.rule, center, self.num_shards,
+                snapshot_path=self._snapshot_path,
+                snapshot_every=self._snapshot_every)
+        return HostParameterServer(
+            self.rule, center, snapshot_path=self._snapshot_path,
+            snapshot_every=self._snapshot_every)
+
+    @staticmethod
+    def _bind(host: str, port: int) -> socket.socket:
+        sock = socket.socket()
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((host, port))
+        return sock
+
+    @property
+    def epoch(self) -> int:
+        return int(self.ps.epoch)
+
+    def set_peers(self, specs: Sequence[dict], index: int) -> None:
+        """Install the group's shared, ORDERED address list (every
+        replica holds the identical list — the order is the election
+        tie-break) and this node's position in it.  Each spec is
+        ``{"worker": (host, port), "repl": (host, port)}``."""
+        peers = [{"worker": (str(s["worker"][0]),
+                             int(s["worker"][1])),
+                  "repl": (str(s["repl"][0]), int(s["repl"][1]))}
+                 for s in specs]
+        with self._lock:
+            self.peers = peers
+            self.index = int(index)
+
+    def start(self) -> "PSReplica":
+        if not self._started:
+            self._started = True
+            self._accept_thread.start()
+            self._monitor_thread.start()
+        return self
+
+    # -- promotion / demotion ------------------------------------------
+
+    def promote(self, reason: str = "manual") -> "PSReplica":
+        """Become the primary: bump the fencing epoch, start the
+        worker-facing ``PSServer`` on the reserved advertised port and
+        a ``Replicator`` to every peer.  Idempotent while primary."""
+        with self._lock:
+            if self.role == "primary" or self._stop.is_set():
+                return self
+            new_epoch = int(self.ps.epoch) + 1
+            self.ps.epoch = new_epoch
+            self.ps._fenced = False
+            self._diverged = False
+            self.role = "primary"
+            self._ensure_worker_sock_locked()
+            standbys = [p["repl"] for i, p in enumerate(self.peers)
+                        if i != self.index]
+            repl = Replicator(
+                self.ps, standbys, epoch=new_epoch, mode=self.mode,
+                start_seq=int(self.last_applied) + 1,
+                ack_timeout=self.ack_timeout,
+                heartbeat_s=self.heartbeat_s, max_lag=self.max_lag)
+            self.replicator = repl
+            self.ps.attach_replicator(repl)
+            self.server = PSServer(self.ps, self._template,
+                                   sock=self._worker_sock).start()
+            last = int(self.last_applied)
+        telemetry.metrics().counter("ps_promotions_total").inc()
+        flight_recorder.record(
+            "ps_promote", epoch=new_epoch, last_applied=last,
+            port=int(self.worker_address[1]), reason=str(reason))
+        flight_recorder.flush(fsync=True)
+        repl.start()
+        return self
+
+    def _ensure_worker_sock_locked(self) -> None:
+        if self._worker_sock.fileno() == -1:  # closed by a demotion
+            self._worker_sock = self._bind(self.worker_address[0],
+                                           self.worker_address[1])
+
+    def _adopt_epoch_locked(self, epoch: int, post: list) -> None:
+        """A newer primary exists (higher epoch on the wire): adopt it
+        and — if this node believed itself primary — demote.  The
+        deposed node's state may hold commits the new primary never
+        saw, so it is marked diverged: every append gets the
+        bootstrap-me gap reply until a full resync rewinds it."""
+        self.ps.epoch = int(epoch)
+        if self.role == "primary":
+            self.role = "standby"
+            self._diverged = True
+            server, self.server = self.server, None
+            repl, self.replicator = self.replicator, None
+            post.append(lambda: self._finish_demotion(
+                server, repl, int(epoch)))
+        self._last_contact = telemetry.now()
+
+    def _finish_demotion(self, server, repl, epoch: int) -> None:
+        """Demotion's slow half, OUTSIDE the node lock: fence the inner
+        PS (in-flight worker commits raise ``PSFencedError``), tear
+        down the worker server and the shipper, and re-reserve the
+        advertised worker port for a future re-promotion."""
+        self.ps.fence(epoch)
+        if repl is not None:
+            repl.stop()
+        if server is not None:
+            server.stop()
+            with self._lock:
+                try:
+                    self._ensure_worker_sock_locked()
+                except OSError:
+                    pass  # port briefly busy; re-promotion retries
+        flight_recorder.record("ps_fenced", role="demoted",
+                               epoch=int(epoch),
+                               port=int(self.worker_address[1]))
+        flight_recorder.flush()
+
+    # -- replication listener (always on) ------------------------------
+
+    def _accept_loop(self) -> None:
+        self._repl_sock.settimeout(0.2)
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._repl_sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+                self._repl_conns.append(conn)
+                threading.Thread(target=self._serve_repl,
+                                 args=(conn,), daemon=True).start()
+        finally:
+            try:
+                self._repl_sock.close()
+            except OSError:
+                pass
+
+    def _serve_repl(self, conn: socket.socket) -> None:
+        with conn:
+            try:
+                while not self._stop.is_set():
+                    msg = transport.recv_msg_into(conn)
+                    reply, post = self._dispatch_repl(msg)
+                    transport.send_msg(conn, reply)
+                    for fn in post:
+                        fn()
+            except (ConnectionError, OSError, ValueError):
+                return
+
+    def _dispatch_repl(self, msg) -> tuple[bytes, list]:
+        cmd = bytes(msg[:1])
+        if cmd == b"?":
+            with self._lock:
+                obj = {"epoch": int(self.ps.epoch),
+                       "last_applied": int(self.last_applied),
+                       "role": self.role, "index": int(self.index)}
+            return transport.pack_obj(obj), []
+        epoch = int.from_bytes(msg[1:9], "big")
+        seq = int.from_bytes(msg[9:17], "big")
+        if cmd == b"a":
+            return self._append(epoch, seq, msg[17:])
+        if cmd == b"h":
+            return self._heartbeat(epoch, seq)
+        if cmd == b"b":
+            return self._bootstrap(epoch, seq, msg[17:])
+        raise ValueError(f"unknown replication command {cmd!r}")
+
+    def _gate_epoch_locked(self, epoch: int,
+                           post: list) -> Optional[bytes]:
+        """Common epoch check: fence a stale primary (reply ``f``),
+        adopt a newer epoch (demoting if needed), stamp liveness.
+        Returns the fence reply, or ``None`` to proceed."""
+        my = int(self.ps.epoch)
+        if epoch < my:
+            post.append(
+                lambda: self._record_fence_reject(epoch, my))
+            return b"f" + my.to_bytes(8, "big")
+        if epoch > my:
+            self._adopt_epoch_locked(epoch, post)
+        self._last_contact = telemetry.now()
+        return None
+
+    def _record_fence_reject(self, their_epoch: int,
+                             my_epoch: int) -> None:
+        telemetry.metrics().counter("ps_fenced_total").inc()
+        flight_recorder.record("ps_fenced", role="standby",
+                               epoch=int(my_epoch),
+                               stale_epoch=int(their_epoch))
+
+    def _append(self, epoch: int, seq: int,
+                data) -> tuple[bytes, list]:
+        post: list = []
+        entry = transport.unpack_obj(data)
+        with self._lock:
+            fence = self._gate_epoch_locked(epoch, post)
+            if fence is not None:
+                return fence, post
+            if self._diverged:
+                return (b"g" + _BOOTSTRAP_ME.to_bytes(8, "big"),
+                        post)
+            if seq <= self.last_applied:
+                # duplicate ship (our ack was lost): fast-forward the
+                # primary — the entry was already applied exactly once
+                return (b"k" + self.last_applied.to_bytes(8, "big"),
+                        post)
+            if seq != self.last_applied + 1:
+                return (b"g"
+                        + (self.last_applied + 1).to_bytes(8, "big"),
+                        post)
+            self._apply_entry_locked(entry)
+            self.last_applied = seq
+            return b"k" + seq.to_bytes(8, "big"), post
+
+    def _heartbeat(self, epoch: int,
+                   head: int) -> tuple[bytes, list]:
+        post: list = []
+        with self._lock:
+            fence = self._gate_epoch_locked(epoch, post)
+            if fence is not None:
+                return fence, post
+            if self._diverged:
+                return (b"g" + _BOOTSTRAP_ME.to_bytes(8, "big"),
+                        post)
+            if head > self.last_applied:
+                return (b"g"
+                        + (self.last_applied + 1).to_bytes(8, "big"),
+                        post)
+            return (b"k" + self.last_applied.to_bytes(8, "big"),
+                    post)
+
+    def _bootstrap(self, epoch: int, head: int,
+                   data) -> tuple[bytes, list]:
+        post: list = []
+        snap = transport.unpack_obj(data)
+        with self._lock:
+            fence = self._gate_epoch_locked(epoch, post)
+            if fence is not None:
+                return fence, post
+            # full-state rewind: replace the inner PS wholesale (no
+            # worker server runs on a standby, so nothing aliases it)
+            self.ps = _ps_from_snapshot(
+                self.rule, snap, snapshot_path=self._snapshot_path,
+                snapshot_every=self._snapshot_every)
+            self.ps.epoch = int(epoch)
+            self.last_applied = int(head)
+            self._diverged = False
+            return b"k" + int(head).to_bytes(8, "big"), post
+
+    def _apply_entry_locked(self, entry: dict) -> None:
+        seq = int(entry["seq"])
+        dedupe_seq = None if seq == _NO_SEQ else seq
+        if str(entry["kind"]) == "shard_commit":
+            self.ps.apply_replicated_shard(
+                int(entry["shard"]), int(entry["worker"]),
+                bytes(entry["payload"]), dedupe_seq,
+                int(entry["staleness"]), bytes(entry["reply"]))
+        else:
+            self.ps.apply_replicated(
+                int(entry["worker"]), bytes(entry["payload"]),
+                dedupe_seq, int(entry["staleness"]),
+                bytes(entry["reply"]))
+
+    # -- failure detection + election ----------------------------------
+
+    def _monitor_loop(self) -> None:
+        # capped: a deposed primary must notice its replicator was
+        # fenced promptly even under a lazy election timeout
+        poll = min(self.failover_timeout / 4.0, 0.25)
+        while not self._stop.wait(poll):
+            try:
+                self._monitor_tick()
+            except Exception:
+                continue  # a flaky probe must not kill the monitor
+
+    def _monitor_tick(self) -> None:
+        with self._lock:
+            role, repl = self.role, self.replicator
+        if role == "primary":
+            if repl is not None and repl.fenced:
+                post: list = []
+                with self._lock:
+                    if (self.role == "primary"
+                            and self.replicator is repl):
+                        self._adopt_epoch_locked(
+                            int(repl.newer_epoch), post)
+                for fn in post:
+                    fn()
+            return
+        with self._lock:
+            quiet = telemetry.now() - self._last_contact
+            have_peers = len(self.peers) > 0
+        if quiet < self.failover_timeout or not have_peers:
+            return
+        self._run_election()
+
+    def _run_election(self) -> None:
+        """The primary went quiet: probe EVERY peer before declaring it
+        dead (a slow primary resets the clock), then promote the
+        deterministic winner over the reachable candidate set."""
+        with self._lock:
+            my_epoch = int(self.ps.epoch)
+            my_applied = int(self.last_applied)
+            peers = list(self.peers)
+            index = int(self.index)
+        cands = [(my_epoch, my_applied, index)]
+        primary_alive = False
+        for i, peer in enumerate(peers):
+            if i == index:
+                continue
+            st = query_status(peer["repl"],
+                              timeout=self.probe_timeout)
+            if st is None:
+                continue
+            if st["role"] == "primary" and st["epoch"] >= my_epoch:
+                primary_alive = True
+            cands.append((st["epoch"], st["last_applied"], i))
+        if primary_alive:
+            # probe-before-declare-dead: it answered, so the silence
+            # was the link or scheduling, not a death — reset the
+            # clock instead of deposing a live primary
+            with self._lock:
+                self._last_contact = telemetry.now()
+            return
+        if elect(cands) == index:
+            self.promote(reason="failover")
+        else:
+            # the winner gets a full failover_timeout to take over
+            # before this node re-opens the election
+            with self._lock:
+                self._last_contact = telemetry.now()
+
+    # -- snapshot / restart --------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The inner PS snapshot (center, clocks, dedupe table, epoch)
+        plus this node's replication position — everything a standby
+        restart needs to rejoin with a catch-up instead of a full
+        bootstrap."""
+        with self._lock:
+            snap = self.ps.snapshot()
+            snap["repl_last_applied"] = int(self.last_applied)
+        return snap
+
+    @classmethod
+    def from_snapshot(cls, rule: UpdateRule, snapshot: dict,
+                      **kwargs) -> "PSReplica":
+        """Restart a replica from ``snapshot()`` output: the inner PS
+        restores warm (dedupe table included) and ``last_applied``
+        resumes from the saved position, so the primary's next append
+        finds a standby that only needs the entries it missed while
+        down."""
+        shards = int(snapshot.get("sharded", 1))
+        node = cls(rule, snapshot["center"], num_shards=shards,
+                   **kwargs)
+        node.ps = _ps_from_snapshot(
+            rule, snapshot, snapshot_path=node._snapshot_path,
+            snapshot_every=node._snapshot_every)
+        node.last_applied = int(snapshot.get("repl_last_applied", 0))
+        return node
+
+    # -- shutdown ------------------------------------------------------
+
+    def stop(self) -> None:
+        """Graceful teardown (tests' cleanup path — a real failover
+        drill uses ``kill``)."""
+        self._stop.set()
+        with self._lock:
+            server, self.server = self.server, None
+            repl, self.replicator = self.replicator, None
+        if repl is not None:
+            repl.stop()
+        if server is not None:
+            server.stop()
+        for s in (self._repl_sock, self._worker_sock,
+                  *self._repl_conns):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        """Crash simulation: every socket — worker-facing, replication
+        listener, live links — dies at once with no courtesy.  The
+        worker server's ``kill`` records the fsynced ``ps_kill``
+        flight marker the postmortem keys on."""
+        self._stop.set()
+        with self._lock:
+            server, self.server = self.server, None
+            repl, self.replicator = self.replicator, None
+        if server is not None:
+            server.kill()
+        if repl is not None:
+            repl.stop()
+        for s in (self._repl_sock, self._worker_sock,
+                  *self._repl_conns):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "PSReplica":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def make_replica_group(rule: UpdateRule, center: Pytree, *,
+                       replicas: int = 2, num_shards: int = 1,
+                       host: str = "127.0.0.1",
+                       **node_kwargs) -> list[PSReplica]:
+    """Construct and start an N-replica group in this process: every
+    node gets the same ordered peer list (index order = address order =
+    election tie-break order) and node 0 is promoted as the initial
+    primary (epoch 1).  Workers connect via
+    ``ResilientPSClient.for_replicas([n.worker_address for n in
+    nodes], ...)`` — or ``trainers``' ``ps_replicas=`` — and survive a
+    ``nodes[0].kill()`` without operator action."""
+    if int(replicas) < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    nodes = [PSReplica(rule, center, num_shards=num_shards,
+                       host=host, **node_kwargs)
+             for _ in range(int(replicas))]
+    specs = [{"worker": n.worker_address, "repl": n.repl_address}
+             for n in nodes]
+    for i, node in enumerate(nodes):
+        node.set_peers(specs, i)
+        node.start()
+    nodes[0].promote(reason="bootstrap")
+    return nodes

@@ -66,6 +66,7 @@ from distkeras_tpu.parallel.host_ps import (
     _readonly_view,
     _to_numpy,
     HostParameterServer,
+    PSFencedError,
 )
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 
@@ -199,6 +200,11 @@ class ShardedParameterServer:
                         for idx in self.plan]
         self._seen_lock = racecheck.lock("sharded_ps.seen")
         self._last_seen: dict[int, float] = {}
+        # replication (replicated_ps): same plain attributes as the
+        # unsharded server — written at attach/fence, read per commit
+        self.epoch = 0
+        self._fenced = False
+        self._replicator = None
         self.num_snapshots = 0
         self._snapshot_path = snapshot_path
         self._snapshot_every = int(snapshot_every)
@@ -261,6 +267,50 @@ class ShardedParameterServer:
     def _set_reply_gauge(self) -> None:
         telemetry.metrics().gauge("ps_reply_cache_bytes").set(
             sum(s.reply_bytes for s in self._shards))
+
+    # -- replication (replicated_ps) --------------------------------------
+
+    def attach_replicator(self, replicator) -> None:
+        """Install the primary-side log shipper: every shard commit is
+        shipped from inside that shard's lock (see ``commit_shard``)."""
+        self._replicator = replicator
+
+    def fence(self, epoch: int) -> None:
+        """Depose this server (see ``HostParameterServer.fence``)."""
+        self._fenced = True
+        self.epoch = max(self.epoch, int(epoch))
+        telemetry.metrics().counter("ps_fenced_total").inc()
+
+    def apply_replicated_shard(self, shard: int, worker_id: int,
+                               payload: bytes, seq: int | None,
+                               staleness: int, reply: bytes) -> None:
+        """Standby-side replay of one shard commit (the sharded twin
+        of ``HostParameterServer.apply_replicated``): the shipped
+        staleness and reply bytes are installed verbatim, so center,
+        clocks and the per-shard dedupe table all match the primary."""
+        s = self._shards[shard]
+        with s.lock:
+            leaves = unpack_leaves(s.center, payload)
+            state = PSState(center=s.center, clock=np.int32(s.clock))
+            new_state = self.rule.commit(state, leaves,
+                                         np.int32(staleness))
+            s.center = [np.asarray(x) for x in new_state.center]
+            s.clock += 1
+            s.pull_clock[worker_id] = s.clock
+            s.staleness_log.append(int(staleness))
+            if len(s.staleness_log) > \
+                    self.STALENESS_LOG_WINDOW * 5 // 4:
+                del s.staleness_log[:-self.STALENESS_LOG_WINDOW]
+            s.num_commits += 1
+            if seq is not None:
+                old = s.last_reply.get(worker_id)
+                if old is not None:
+                    s.reply_bytes -= len(old[1])
+                s.last_reply[worker_id] = (int(seq), bytes(reply))
+                s.reply_bytes += len(reply)
+            if (shard == self.num_shards - 1 and self._snapshot_every
+                    and s.num_commits % self._snapshot_every == 0):
+                self._write_snapshot_holding(shard)
 
     # -- per-shard verbs (the sharded wire) --------------------------------
 
@@ -333,6 +383,11 @@ class ShardedParameterServer:
         try:
             with telemetry.span("ps_shard_commit", worker=worker_id,
                                 shard=shard):
+                if self._fenced:
+                    raise PSFencedError(
+                        f"commit rejected: this server was deposed "
+                        f"(a newer primary holds epoch > "
+                        f"{self.epoch})")
                 if seq is not None:
                     last = s.last_reply.get(worker_id)
                     if last is not None and seq <= last[0]:
@@ -360,13 +415,26 @@ class ShardedParameterServer:
                             buckets=telemetry.STALENESS_BUCKETS
                             ).observe(int(staleness))
                 pulled = [np.asarray(x) for x in pulled]
+                reply_packed = b""
                 if seq is not None:
                     old = s.last_reply.get(worker_id)
                     if old is not None:
                         s.reply_bytes -= len(old[1])
-                    packed = pack_leaves(pulled)
-                    s.last_reply[worker_id] = (seq, packed)
-                    s.reply_bytes += len(packed)
+                    reply_packed = pack_leaves(pulled)
+                    s.last_reply[worker_id] = (seq, reply_packed)
+                    s.reply_bytes += len(reply_packed)
+                if self._replicator is not None:
+                    # under THIS shard's lock, before the reply
+                    # escapes: the log's per-shard subsequence matches
+                    # the shard-lock order, so the standby's replay
+                    # reconstructs each shard byte-identically
+                    self._replicator.replicate(
+                        kind="shard_commit", worker=worker_id,
+                        shard=shard,
+                        payload=pack_leaves(leaves, s.center),
+                        seq=_NO_SEQ if seq is None else int(seq),
+                        staleness=int(staleness),
+                        reply=reply_packed)
                 if shard == self.num_shards - 1:
                     m.counter("ps_commits_total").inc()
                     # one flight event per LOGICAL commit (its last
@@ -467,32 +535,55 @@ class ShardedParameterServer:
                 if k != held:
                     s.lock.acquire()
                     taken.append(s)
-            center: list = [None] * self._n_leaves
-            shards = []
-            for s in self._shards:
-                for i, x in zip(s.idx, s.center):
-                    center[i] = x
-                shards.append({
-                    "clock": s.clock,
-                    "num_commits": s.num_commits,
-                    "pull_clock": {str(w): c
-                                   for w, c in s.pull_clock.items()},
-                    "staleness_log": np.asarray(s.staleness_log,
-                                                np.int64),
-                    "last_reply": {str(w): {"seq": np.uint64(seq),
-                                            "packed": packed}
-                                   for w, (seq, packed)
-                                   in s.last_reply.items()},
-                })
-            return {
-                "sharded": self.num_shards,
-                "center": jax.tree_util.tree_unflatten(self._treedef,
-                                                       center),
-                "shards": shards,
-            }
+            return self._build_snapshot_all_locked()
         finally:
             for s in taken:
                 s.lock.release()
+
+    def replication_snapshot(self, head_fn) -> tuple[int, dict]:
+        """A ``(replication-log head seq, snapshot dict)`` pair that is
+        CONSISTENT: both are read under ALL shard locks, where no
+        shard commit — hence no log-seq assignment (``commit_shard``
+        replicates inside its shard's lock) — can be mid-flight, so
+        the snapshot contains exactly the commits through ``head``
+        (the standby bootstrap's correctness condition; ``head_fn`` is
+        the replicator's ``head_seq``, and lock order stays shard ->
+        replicator, same as the in-commit ship path)."""
+        taken = []
+        try:
+            for s in self._shards:
+                s.lock.acquire()
+                taken.append(s)
+            return int(head_fn()), self._build_snapshot_all_locked()
+        finally:
+            for s in taken:
+                s.lock.release()
+
+    def _build_snapshot_all_locked(self) -> dict:
+        center: list = [None] * self._n_leaves
+        shards = []
+        for s in self._shards:
+            for i, x in zip(s.idx, s.center):
+                center[i] = x
+            shards.append({
+                "clock": s.clock,
+                "num_commits": s.num_commits,
+                "pull_clock": {str(w): c
+                               for w, c in s.pull_clock.items()},
+                "staleness_log": np.asarray(s.staleness_log,
+                                            np.int64),
+                "last_reply": {str(w): {"seq": np.uint64(seq),
+                                        "packed": packed}
+                               for w, (seq, packed)
+                               in s.last_reply.items()},
+            })
+        return {
+            "sharded": self.num_shards,
+            "epoch": self.epoch,
+            "center": jax.tree_util.tree_unflatten(self._treedef,
+                                                   center),
+            "shards": shards,
+        }
 
     def snapshot(self) -> dict:
         """Point-in-time warm-restart state across ALL shards (taken
@@ -547,6 +638,7 @@ class ShardedParameterServer:
                  int(snapshot["sharded"]),
                  snapshot_path=snapshot_path,
                  snapshot_every=snapshot_every)
+        ps.epoch = int(snapshot.get("epoch", 0))
         if len(snapshot["shards"]) != ps.num_shards:
             raise ValueError(
                 f"snapshot holds {len(snapshot['shards'])} shards, "

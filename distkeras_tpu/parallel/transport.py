@@ -238,6 +238,17 @@ WIRE_OPS.register("ps", b"P", "pull_since")
 WIRE_OPS.register("ps", b"C", "commit_shard")
 WIRE_OPS.register("ps", b"d", "done")
 WIRE_OPS.register("ps", b"s", "stop")
+WIRE_OPS.register("ps", b"E", "epoch")
+WIRE_OPS.register("ps", b"V", "center_obj")
+# PS replication protocol (replicated_ps: primary -> standby log
+# shipping plus the standby's replies; requests a/h/?/b, replies k/f/g)
+WIRE_OPS.register("repl", b"a", "append")
+WIRE_OPS.register("repl", b"h", "heartbeat")
+WIRE_OPS.register("repl", b"?", "status")
+WIRE_OPS.register("repl", b"b", "bootstrap")
+WIRE_OPS.register("repl", b"k", "ack")
+WIRE_OPS.register("repl", b"f", "fenced")
+WIRE_OPS.register("repl", b"g", "gap")
 # serving-replica protocol (gateway.ReplicaServer._dispatch)
 WIRE_OPS.register("replica", b"g", "generate")
 WIRE_OPS.register("replica", b"h", "health")

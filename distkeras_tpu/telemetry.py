@@ -999,6 +999,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
     "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
+    "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
 }
 
 #: Signals where LOW is bad: the comparison inverts (breach at/below
@@ -1030,7 +1031,8 @@ class SLOWatchdog:
 
     The signals (PS staleness p99, client retry rate, serving shed
     rate, queue depth, TTFT p95, idle-worker fraction, gateway
-    failover rate, prefix hit rate) are computed from the registry's
+    failover rate, prefix hit rate, PS standby replication lag) are
+    computed from the registry's
     live metrics and compared against ``(degraded_at, critical_at)``
     thresholds — inverted for ``LOWER_IS_WORSE_SLO_SIGNALS``, where a
     LOW value breaches; the worst breach decides
@@ -1113,6 +1115,13 @@ class SLOWatchdog:
             # inverted signal (see LOWER_IS_WORSE_SLO_SIGNALS) — a
             # LOW rate on a shared-prefix workload is the breach
             out["prefix_hit_rate"] = phits / max(phits + pmiss, 1.0)
+        lag = r.collect("ps_standby_lag")
+        if lag:
+            # how many commit-log entries the slowest PS standby is
+            # behind the primary: bounds the failover data-loss window
+            # in async replication mode (sync mode pins it near 0)
+            out["ps_standby_lag"] = float(
+                max(m.value for _, m in lag))
         return out
 
     # -- evaluation ---------------------------------------------------

@@ -791,6 +791,7 @@ class DistributedTrainer(Trainer):
                  lr_law: str = "warn",
                  commit_overlap: bool = False,
                  ps_address: tuple[str, int] | None = None,
+                 ps_replicas: list | None = None,
                  ps_shards: int = 1,
                  ps_snapshot_path: str | None = None,
                  ps_snapshot_every: int = 0, **kwargs):
@@ -848,6 +849,23 @@ class DistributedTrainer(Trainer):
         must match this trainer's; staleness history stays
         server-side).
 
+        ``ps_replicas=[(host, port), ...]`` attaches to a REPLICATED
+        external PS (``parallel.replicated_ps``): the ORDERED worker
+        address list of the replica group (the same order every
+        replica holds — it is also the promotion tie-break).  Each
+        worker's client walks the list with probe-before-declare-dead
+        (``ResilientPSClient.for_replicas``), so a primary kill
+        mid-training fails over to the promoted standby with the
+        retried commit deduped by the replicated commit log — no
+        operator action, byte-identical final center.
+        ``history['ps_failovers']`` counts client-observed failovers;
+        ``history['ps_epoch']`` records the serving replica's fencing
+        epoch at the end of the run.  Mutually exclusive with
+        ``ps_address`` (a one-element list is the unreplicated
+        equivalent); same contract otherwise — socket transport, the
+        group outlives the driver, snapshotting configured on the
+        replicas.
+
         ``ps_shards=K`` (host arm, delta family) runs the PS sharded
         (``parallel.sharded_ps``): the parameter tree's leaves are
         partitioned into K byte-balanced shards, each with its own
@@ -895,6 +913,17 @@ class DistributedTrainer(Trainer):
         self.ps_address = (None if ps_address is None
                            else (str(ps_address[0]),
                                  int(ps_address[1])))
+        self.ps_replicas = (None if ps_replicas is None
+                            else [(str(h), int(p))
+                                  for h, p in ps_replicas])
+        if self.ps_replicas is not None and not self.ps_replicas:
+            raise ValueError(
+                "ps_replicas needs at least one (host, port) address")
+        if ps_address is not None and ps_replicas is not None:
+            raise ValueError(
+                "ps_address and ps_replicas are mutually exclusive — "
+                "a one-element ps_replicas list is the unreplicated "
+                "equivalent")
         self.ps_shards = int(ps_shards)
         if self.ps_shards < 1:
             raise ValueError(
@@ -907,13 +936,14 @@ class DistributedTrainer(Trainer):
                                    or fault_injector is not None
                                    or compression is not None
                                    or ps_address is not None
+                                   or ps_replicas is not None
                                    or self.ps_shards > 1
                                    or ps_snapshot_path is not None
                                    or self.ps_snapshot_every):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
                 "/ fault_injector / compression / ps_address / "
-                "ps_shards / ps_snapshot_* apply only to "
+                "ps_replicas / ps_shards / ps_snapshot_* apply only to "
                 "fidelity='host' (the emulated arms are deterministic; "
                 "recover via checkpoint/resume), got "
                 f"fidelity={fidelity!r}")
@@ -921,6 +951,11 @@ class DistributedTrainer(Trainer):
             raise ValueError(
                 "ps_address attaches to an external PSServer over TCP; "
                 f"it requires transport='socket', got {transport!r}")
+        if ps_replicas is not None and transport != "socket":
+            raise ValueError(
+                "ps_replicas attaches to an external replica group "
+                "over TCP; it requires transport='socket', got "
+                f"{transport!r}")
         if self.ps_snapshot_every and ps_snapshot_path is None:
             raise ValueError(
                 "ps_snapshot_every needs ps_snapshot_path to write to")
@@ -930,6 +965,12 @@ class DistributedTrainer(Trainer):
                 "with an external ps_address, configure snapshotting "
                 "on the externally created HostParameterServer, not "
                 "on the trainer (the driver does not own the server)")
+        if ps_replicas is not None and (ps_snapshot_path is not None
+                                        or self.ps_snapshot_every):
+            raise ValueError(
+                "with ps_replicas, configure snapshotting on the "
+                "PSReplica nodes, not on the trainer (the driver does "
+                "not own the replica group)")
         self.commit_overlap = bool(commit_overlap)
         if self.commit_overlap and fidelity not in ("faithful",
                                                     "host"):
@@ -1472,7 +1513,7 @@ class DistributedTrainer(Trainer):
                                                         resolve_codec)
         from distkeras_tpu.parallel.host_ps import (
             HostParameterServer, PSClient, PSRetryExhausted, PSServer,
-            ResilientPSClient)
+            ResilientPSClient, fetch_epoch)
         from distkeras_tpu.utils import (tree_add, tree_sub,
                                          tree_zeros_like)
 
@@ -1526,6 +1567,10 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     "external ps_address does not compose with "
                     "multi-host runs (process 0 hosts the PS there)")
+            if self.ps_replicas is not None:
+                raise ValueError(
+                    "ps_replicas does not compose with multi-host "
+                    "runs (process 0 hosts the PS there)")
 
         shard_plan = None
         if self.ps_shards > 1:
@@ -1539,7 +1584,8 @@ class DistributedTrainer(Trainer):
 
         ps = None
         server = None
-        if self.ps_address is None and (not multi or rank == 0):
+        if (self.ps_address is None and self.ps_replicas is None
+                and (not multi or rank == 0)):
             if self.ps_shards > 1:
                 from distkeras_tpu.parallel.sharded_ps import (
                     ShardedParameterServer)
@@ -1604,6 +1650,7 @@ class DistributedTrainer(Trainer):
         raw_total = telemetry.Counter()
         skip_total = telemetry.Counter()    # version-delta pull savings
         saved_total = telemetry.Counter()   # (sharded socket arm)
+        failover_total = telemetry.Counter()  # ps_replicas client arm
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -1750,7 +1797,8 @@ class DistributedTrainer(Trainer):
             retry_kw = dict(retries=self.worker_retries,
                             seed=self.seed + 101 * w,
                             on_retry=on_retry)
-            socket_arm = ps_address is not None
+            socket_arm = (ps_address is not None
+                          or self.ps_replicas is not None)
             sharded_socket = socket_arm and self.ps_shards > 1
             # per-worker, so client instances (rebuilt per reconnect)
             # accumulate race-free; folded into the shared counters
@@ -1758,7 +1806,12 @@ class DistributedTrainer(Trainer):
             shard_stats = ({"pull_shards_skipped": 0,
                             "pull_bytes_saved": 0}
                            if sharded_socket else None)
-            if socket_arm:
+            if self.ps_replicas is not None:
+                client = ResilientPSClient.for_replicas(
+                    self.ps_replicas, worker_id=w, template=center,
+                    codec=codec, shards=self.ps_shards,
+                    shard_stats=shard_stats, **retry_kw)
+            elif socket_arm:
                 client = ResilientPSClient.for_address(
                     *ps_address, worker_id=w, template=center,
                     codec=codec, shards=self.ps_shards,
@@ -1978,6 +2031,10 @@ class DistributedTrainer(Trainer):
                 if shard_stats is not None:
                     skip_total.inc(shard_stats["pull_shards_skipped"])
                     saved_total.inc(shard_stats["pull_bytes_saved"])
+                if self.ps_replicas is not None:
+                    # the cycler survives reconnects, so its count is
+                    # this worker's whole-run failover total
+                    failover_total.inc(client.replicas.failovers)
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in local_workers]
@@ -2107,6 +2164,23 @@ class DistributedTrainer(Trainer):
         elif ps is not None:
             self._record(staleness=list(ps.staleness_log))
             final_center = ps.center
+        elif self.ps_replicas is not None:
+            # replicated external PS: the final center is pulled
+            # through the SAME multi-address failover path the workers
+            # used — the group may have promoted mid-run, so a pinned
+            # address could point at a fenced ex-primary
+            fin = ResilientPSClient.for_replicas(
+                self.ps_replicas, worker_id=num_workers,
+                template=center, retries=self.worker_retries,
+                seed=self.seed, use_seq=False)
+            try:
+                final_center = fin.pull()
+                fin.done()
+                self._record(
+                    ps_failovers=int(failover_total.value),
+                    ps_epoch=fetch_epoch(*fin.replicas.current()))
+            finally:
+                fin.close()
         else:
             # external ps_address: the final center is pulled over the
             # wire; staleness history stays server-side (the PS

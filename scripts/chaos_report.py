@@ -1,7 +1,7 @@
 """Chaos / recovery report — exercise the fault-tolerance layer end to
 end and summarize the recovery evidence from the telemetry registry.
 
-Two scenarios (both run by ``--smoke``, the tier-1 registration via
+Three scenarios (all run by ``--smoke``, the tier-1 registration via
 test_examples.py's scripts-coverage check; tune them with the flags):
 
 1. **Chaos-scheduled SOCKET training round** — an async host-PS
@@ -13,11 +13,16 @@ test_examples.py's scripts-coverage check; tune them with the flags):
    admission queue under 2x queue-bound overload: excess submits shed
    (``serving_shed_total``), a poisoned request is isolated as an
    ``error`` result, and ``drain()`` returns every accepted request.
+3. **Replicated-PS primary kill** (ISSUE 10) — a 2-node replica group
+   loses its primary mid-training: the standby self-promotes (epoch
+   2), the workers fail over, commits lost must be ZERO, and the
+   kill -> promote latency plus the run's commit throughput are gated
+   through ``perf_regress`` (the latency lower-is-better).
 
 The report prints, per layer: injected fault counts, client retries and
-backoff spent, commit/dedupe/snapshot counters, shed/error counts —
-the "what fired, what recovered, what it cost" summary an operator
-would want after a chaos day.
+backoff spent, commit/dedupe/snapshot counters, shed/error counts,
+promotion latency and epoch — the "what fired, what recovered, what it
+cost" summary an operator would want after a chaos day.
 """
 
 from __future__ import annotations
@@ -29,6 +34,10 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
+if str(REPO / "scripts") not in sys.path:
+    sys.path.insert(0, str(REPO / "scripts"))
+
+import perf_regress  # noqa: E402  (sibling script, path set above)
 
 
 def chaos_training_round(seed: int, rows: int) -> dict:
@@ -64,6 +73,116 @@ def chaos_training_round(seed: int, rows: int) -> dict:
             "retried_rounds": sum(map(len, t.history.get(
                 "worker_round_retries", []))),
             "final_loss": float(loss[-1])}
+
+
+def failover_round(rows: int, out_dir: str) -> dict:
+    """Scenario 3 (ISSUE 10): kill the PRIMARY of a 2-node replicated
+    PS group mid-training.  The standby must promote itself (epoch
+    bump), every worker's ``ResilientPSClient`` must walk its replica
+    list onto the new primary, and the run must finish with ZERO lost
+    commits (the promoted node's commit count == completed rounds —
+    the replicated dedupe table keeps retried commits exactly-once
+    across the failover).  Promotion latency is measured from the
+    fsynced ``ps_kill`` flight event to the successor's ``ps_promote``
+    and gated through ``perf_regress``."""
+    import json
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu import flight_recorder, telemetry
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import ModelSpec, model_config
+    from distkeras_tpu.parallel.replicated_ps import make_replica_group
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    flight_dir = out / "flight"
+
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    data = datasets.synthetic_classification(rows, (8,), 4, seed=0)
+    model = ModelSpec.from_config(mlp).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+
+    flight_recorder.start(flight_dir)
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=0.5)
+    try:
+        def killer():
+            while nodes[0].ps.num_commits < 3:
+                time.sleep(0.002)
+            nodes[0].kill()
+
+        k = threading.Thread(target=killer)
+        k.start()
+        t0 = time.perf_counter()
+        t = DOWNPOUR(mlp, fidelity="host", transport="socket",
+                     num_workers=2, communication_window=2,
+                     batch_size=16, num_epoch=1, learning_rate=0.01,
+                     worker_optimizer="adam", worker_retries=14,
+                     ps_replicas=[n.worker_address for n in nodes])
+        t.train(data)
+        seconds = time.perf_counter() - t0
+        k.join()
+        rounds = len(t.history["round_loss"])
+        commits = nodes[1].ps.num_commits
+        epoch = nodes[1].ps.epoch
+    finally:
+        for n in nodes:
+            n.stop()
+    events = flight_recorder.active().read_events()
+    flight_recorder.stop()
+
+    kills = [e for e in events if e["kind"] == "ps_kill"]
+    promotes = [e for e in events if e["kind"] == "ps_promote"
+                and e["reason"] == "failover"]
+    assert kills and promotes, (
+        f"failover story incomplete: {len(kills)} kills, "
+        f"{len(promotes)} failover promotions")
+    latency = promotes[0]["wall_s"] - kills[-1]["wall_s"]
+    assert commits == rounds, (
+        f"commits lost across failover: {commits} commits for "
+        f"{rounds} rounds")
+    assert t.history["ps_epoch"][-1] == epoch == 2, (
+        t.history.get("ps_epoch"), epoch)
+    assert t.history["ps_failovers"][-1] >= 1, t.history
+
+    # ---- the perf_regress hookup: gate the recovery cost both ways —
+    # commit throughput (from the live registry) must not collapse,
+    # kill -> promote latency must not balloon (lower is better)
+    snap_path = out / "registry.json"
+    snap_path.write_text(json.dumps(telemetry.metrics().snapshot(),
+                                    default=repr))
+    cands = perf_regress.from_registry(
+        str(snap_path), "failover_commits_per_sec",
+        "ps_commits_total", seconds)
+    latency_cand = [{"metric": "failover_promotion_latency_s",
+                     "value": latency, "unit": "s"}]
+    for i, c in enumerate(cands + latency_cand):
+        for n in (1, 2, 3):  # synthetic trajectory from this very run
+            (out / f"BENCH_fo{i}_r{n:02d}.json").write_text(
+                json.dumps({
+                    "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                    "parsed": {"metric": c["metric"],
+                               "value": c["value"] * (1 + 0.02 * n),
+                               "unit": c.get("unit", "per_sec")}}))
+    traj = perf_regress.load_trajectories(str(out / "BENCH_fo*.json"))
+    gate = (perf_regress.evaluate(cands, traj, tolerance=0.5)
+            + perf_regress.evaluate(latency_cand, traj, tolerance=0.5,
+                                    lower_is_better=True))
+    assert all(r["status"] == "pass" for r in gate), gate
+    return {"rounds": rounds, "commits": commits, "epoch": epoch,
+            "failovers": int(t.history["ps_failovers"][-1]),
+            "worker_retries": sum(map(len, t.history.get(
+                "worker_round_retries", []))),
+            "promotion_latency_s": latency, "gate": gate}
 
 
 def engine_overload_and_drain(seed: int) -> dict:
@@ -131,6 +250,8 @@ def registry_lines(tel) -> list[str]:
     wanted = ("chaos_injected_total", "ps_client_retries_total",
               "ps_commits_total", "ps_commit_dedup_total",
               "ps_snapshots_total", "ps_restarts_total",
+              "ps_promotions_total", "ps_client_failovers_total",
+              "ps_fenced_total", "ps_replicated_entries_total",
               "serving_shed_total", "serving_request_errors_total",
               "serving_finished_total")
     for key, value in sorted(snap["counters"].items()):
@@ -155,13 +276,22 @@ def main():
                     help="training rows for the chaos round")
     ap.add_argument("--out", default=None,
                     help="also write the report to this file")
+    ap.add_argument("--out-dir", default=None,
+                    help="failover-round artifact directory "
+                         "(temp default)")
     args = ap.parse_args()
     if args.smoke:
         args.rows = min(args.rows, 1024)
 
+    import tempfile
+
     from distkeras_tpu import telemetry
 
     tel = telemetry.enable()
+    # failover first: its perf_regress rate candidate reads the
+    # registry while only scenario 3's commits are in it
+    fail = failover_round(args.rows, args.out_dir or tempfile.mkdtemp(
+        prefix="dkt_chaos_fo_"))
     train = chaos_training_round(args.seed, args.rows)
     serve = engine_overload_and_drain(args.seed)
 
@@ -184,6 +314,16 @@ def main():
         f"  isolated as error      {serve['errors']}",
         f"  completed clean        {serve['completed']} "
         "(drain returned every accepted request)",
+        "== scenario 3: replicated-PS primary kill + failover ==",
+        f"  rounds completed       {fail['rounds']}",
+        f"  commits on successor   {fail['commits']} "
+        "(== rounds: commits lost = 0)",
+        f"  fencing epoch          {fail['epoch']}",
+        f"  client failovers       {fail['failovers']}",
+        f"  rounds retried         {fail['worker_retries']}",
+        f"  promotion latency      "
+        f"{fail['promotion_latency_s'] * 1e3:.1f}ms "
+        "(kill -> ps_promote, perf_regress gated)",
     ]
     lines += registry_lines(tel)
     report = "\n".join(lines)
@@ -192,7 +332,8 @@ def main():
         for needle in ("chaos_injected_total", "serving_shed_total",
                        "ps_client_retries_total",
                        "serving_request_errors_total",
-                       "exactly-once held"):
+                       "exactly-once held", "ps_promotions_total",
+                       "commits lost = 0"):
             assert needle in report, f"report lacks {needle}:\n{report}"
         report += "\nsmoke: ok"
     telemetry.disable()

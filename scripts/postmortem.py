@@ -14,6 +14,14 @@ snapshot's dedupe table (``checkpoint.ps_snapshot_info``'s
 durable snapshot (data at risk), agreement proves the restart resumes
 exactly where the flight recorder says the crash happened.
 
+When the ring holds ``ps_promote`` events (a replicated PS group,
+ISSUE 10), the report additionally reconstructs the failover story:
+one line per fencing epoch — who was primary, why it took over, the
+commit-log seq it resumed from and where its reign ended — plus how
+many stale writers each epoch fenced (``ps_fenced``), cross-checked
+against the promoted replica's snapshot epoch
+(``ps_snapshot_info``'s ``epoch``).
+
 Modes:
 
 * ``--flight DIR [--seconds 30] [--snapshot ps.snap]`` — report on an
@@ -40,6 +48,37 @@ if str(REPO) not in sys.path:
 
 
 # ---- reconstruction ----------------------------------------------------
+
+def failover_story(events: list[dict]) -> list[dict]:
+    """The replicated-PS failover timeline: one entry per fencing
+    epoch, derived from the fsynced ``ps_promote`` flights — which
+    node was primary (its worker port), why it took over (``reason``:
+    bootstrap / failover / manual), the commit-log seq it resumed
+    from, and where its reign ended (the NEXT epoch's takeover seq —
+    the two being equal is the commits-lost=0 proof).  ``ps_fenced``
+    events are attached to the epoch that won them: a deposed
+    primary records the ``newer_epoch`` that fenced it; demoted and
+    standby records carry the winning epoch directly."""
+    promotes = sorted((e for e in events if e["kind"] == "ps_promote"),
+                      key=lambda e: int(e["epoch"]))
+    story = []
+    for i, e in enumerate(promotes):
+        nxt = promotes[i + 1] if i + 1 < len(promotes) else None
+        epoch = int(e["epoch"])
+        story.append({
+            "epoch": epoch,
+            "primary_port": int(e["port"]),
+            "reason": e.get("reason"),
+            "took_over_at_seq": int(e["last_applied"]),
+            "reign_ended_at_seq": (int(nxt["last_applied"])
+                                   if nxt else None),
+            "fenced": sum(
+                1 for f in events if f["kind"] == "ps_fenced"
+                and int(f.get("newer_epoch", f.get("epoch", -1)))
+                == epoch),
+        })
+    return story
+
 
 def reconstruct(flight_dir: str, seconds: float = 30.0,
                 snapshot: str | None = None) -> dict:
@@ -70,12 +109,20 @@ def reconstruct(flight_dir: str, seconds: float = 30.0,
         "kinds": dict(collections.Counter(e["kind"] for e in window)),
         "flight_last_acked": acked,
     }
+    story = failover_story(window)
+    if story:
+        report["failover_story"] = story
     if snapshot is not None:
         info = ps_snapshot_info(snapshot)
         report["snapshot"] = info
         report["acked_match"] = (
             {w: int(s) for w, s in info["last_acked"].items()}
             == {w: int(s) for w, s in acked.items()})
+        if story:
+            # the promoted replica's snapshot must have been taken
+            # under the newest epoch the flight ring proves won
+            report["epoch_match"] = (
+                int(info.get("epoch", 0)) == story[-1]["epoch"])
     return report
 
 
@@ -94,6 +141,14 @@ def render(report: dict) -> str:
     lines.append("last acked commit seq per worker (flight): "
                  + json.dumps(report["flight_last_acked"],
                               sort_keys=True))
+    for reign in report.get("failover_story", []):
+        end = reign["reign_ended_at_seq"]
+        lines.append(
+            f"epoch {reign['epoch']}: primary :{reign['primary_port']}"
+            f" ({reign['reason']}) seq {reign['took_over_at_seq']}"
+            + (f" -> {end}" if end is not None else " -> crash/end")
+            + (f", fenced {reign['fenced']} stale writer(s)"
+               if reign["fenced"] else ""))
     if "snapshot" in report:
         info = report["snapshot"]
         lines.append(
@@ -105,6 +160,15 @@ def render(report: dict) -> str:
                         if report["acked_match"] else
                         "MISMATCH — commits applied after the last "
                         "durable snapshot"))
+        if "epoch_match" in report:
+            lines.append(
+                "epoch cross-check: "
+                + (f"MATCH — snapshot taken under the winning epoch "
+                   f"{info['epoch']}"
+                   if report["epoch_match"] else
+                   f"MISMATCH — snapshot epoch {info['epoch']} != "
+                   f"newest promoted epoch "
+                   f"{report['failover_story'][-1]['epoch']}"))
     tail = report["events"][-8:]
     lines.append(f"final {len(tail)} events before the crash:")
     for e in tail:

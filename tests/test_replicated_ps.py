@@ -1,0 +1,334 @@
+"""Replicated parameter server (ISSUE 10): commit-log shipping to hot
+standbys, deterministic election, epoch fencing of deposed primaries,
+exactly-once across failover via the replicated dedupe table, and the
+acceptance drill — chaos-kill the primary mid-training and land on a
+final center byte-identical to the uninterrupted run (K in {1, 4}
+shards)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.analysis import racecheck
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import ModelSpec, model_config
+from distkeras_tpu.parallel.faults import ChaosTransport
+from distkeras_tpu.parallel.host_ps import (PSClient, PSFencedError,
+                                            ResilientPSClient)
+from distkeras_tpu.parallel.replicated_ps import (PSReplica, elect,
+                                                  make_replica_group,
+                                                  query_status)
+from distkeras_tpu.parallel.update_rules import DownpourRule
+from distkeras_tpu.trainers import DOWNPOUR
+
+jax.config.update("jax_platforms", "cpu")
+
+MLP = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+DATA = datasets.synthetic_classification(1024, (8,), 4, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def _racecheck():
+    """The whole replication suite runs under the lockset race +
+    deadlock detector; any report fails the test."""
+    racecheck.enable()
+    yield
+    reports = racecheck.disable()
+    assert not reports, "\n".join(str(r) for r in reports)
+
+
+def _params(seed=0, shapes=((3, 4), (4,))):
+    rng = np.random.default_rng(seed)
+    return {f"w{i}": rng.normal(size=s).astype(np.float32)
+            for i, s in enumerate(shapes)}
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+# ---- election ----------------------------------------------------------
+
+def test_election_is_deterministic():
+    """Highest (epoch, last_applied_seq) wins; ties break by ADDRESS
+    ORDER (lowest index), so every replica evaluating the same
+    candidate set picks the same winner."""
+    assert elect([(1, 5, 0), (1, 7, 1)]) == 1   # longer log wins
+    assert elect([(1, 99, 0), (2, 0, 1)]) == 1  # epoch dominates seq
+    assert elect([(1, 5, 2), (1, 5, 0), (1, 5, 1)]) == 0  # tie: order
+    assert elect([(3, 4, 1)]) == 1
+    with pytest.raises(ValueError, match="at least one"):
+        elect([])
+
+
+# ---- replication + failover --------------------------------------------
+
+def test_kill_primary_fails_over_exactly_once():
+    """Commits replicate to the standby in sync mode; killing the
+    primary promotes the standby (epoch 2) and the resilient client
+    walks onto it; the replicated dedupe table keeps the total applied
+    commits exactly-once, and the surviving center equals the same
+    delta schedule applied to a plain single server."""
+    center = _params(0)
+    delta = {k: np.full_like(v, 0.01) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=0.4)
+    try:
+        cli = ResilientPSClient.for_replicas(
+            [n.worker_address for n in nodes], worker_id=0,
+            template=center, retries=20, backoff_base=0.05, seed=0)
+        try:
+            cli.pull()
+            for _ in range(3):
+                cli.commit(delta)
+            assert nodes[1].last_applied == 3  # sync mode: shipped
+            nodes[0].kill()
+            for _ in range(2):
+                cli.commit(delta)  # rides the failover
+            cli.done()
+        finally:
+            cli.close()
+        assert cli.replicas.failovers >= 1
+        assert nodes[1].role == "primary"
+        assert nodes[1].epoch == 2
+        assert nodes[1].ps.num_commits == 5  # exactly-once held
+        from distkeras_tpu.parallel.host_ps import HostParameterServer
+        ref = HostParameterServer(DownpourRule(), center)
+        ref.pull(0)
+        for s in range(5):
+            ref.commit(0, delta, seq=s)
+        for a, b in zip(jax.tree_util.tree_leaves(nodes[1].ps.center),
+                        jax.tree_util.tree_leaves(ref.center)):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        _stop_all(nodes)
+
+
+def test_lost_ack_retry_dedupes_across_failover():
+    """The exactly-once acceptance in miniature: a commit whose ACK
+    was lost is retried — with the identical seq — against the NEWLY
+    PROMOTED node, whose replicated dedupe table recognizes it and
+    replies from cache instead of applying twice."""
+    center = _params(1)
+    delta = {k: np.full_like(v, 0.5) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=0.4)
+    try:
+        c1 = PSClient(*nodes[0].worker_address, 0, center)
+        c1.pull()
+        c1.commit(delta, seq=0)  # applied + replicated; "ack lost"
+        c1.close()
+        nodes[0].kill()
+        _wait(lambda: nodes[1].role == "primary", msg="promotion")
+        c2 = PSClient(*nodes[1].worker_address, 0, center)
+        c2.pull()
+        c2.commit(delta, seq=0)  # the retry: MUST dedupe
+        c2.close()
+        assert nodes[1].ps.num_commits == 1
+        np.testing.assert_array_equal(
+            nodes[1].ps.center["w0"], center["w0"] + 0.5)
+    finally:
+        _stop_all(nodes)
+
+
+def test_deposed_primary_is_fenced_and_demotes():
+    """Epoch fencing: promoting the standby while the old primary is
+    still alive bumps the epoch; the old primary's replication stream
+    is rejected with the newer epoch, its late commits fail instead of
+    forking history, and it demotes itself to standby."""
+    center = _params(2)
+    delta = {k: np.ones_like(v) for k, v in center.items()}
+    # lazy election timeout: nothing promotes on its own here
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=30.0)
+    try:
+        c0 = PSClient(*nodes[0].worker_address, 0, center)
+        c0.pull()
+        c0.commit(delta, seq=0)
+        nodes[1].promote(reason="manual")  # split brain, on purpose
+        assert nodes[1].epoch == 2
+        # the deposed primary notices the fence and steps down
+        _wait(lambda: nodes[0].role == "standby", msg="demotion")
+        assert nodes[0].epoch == 2
+        # its worker port is back to reserved: late writers are turned
+        # away at the door (refused), or fenced if they raced the
+        # demotion window — either way the commit DOES NOT apply
+        with pytest.raises((ConnectionError, OSError)):
+            c0.commit(delta, seq=1)
+            c0.close()
+            c_late = PSClient(*nodes[0].worker_address, 0, center)
+            c_late.commit(delta, seq=1)
+        assert nodes[1].ps.num_commits == 1
+        status = query_status(nodes[1].repl_address)
+        assert status["role"] == "primary" and status["epoch"] == 2
+    finally:
+        _stop_all(nodes)
+
+
+def test_standby_snapshot_restart_resumes_position():
+    """A standby's snapshot carries the inner PS state (dedupe table
+    included), the fencing epoch, and its replication position;
+    ``from_snapshot`` rejoins at ``last_applied`` so the primary only
+    ships what was missed."""
+    from distkeras_tpu import checkpoint
+
+    center = _params(3)
+    delta = {k: np.ones_like(v) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=30.0)
+    restored = None
+    try:
+        cli = PSClient(*nodes[0].worker_address, 0, center)
+        cli.pull()
+        for s in range(4):
+            cli.commit(delta, seq=s)
+        cli.close()
+        assert nodes[1].last_applied == 4
+        snap = nodes[1].snapshot()
+        assert snap["repl_last_applied"] == 4
+        restored = PSReplica.from_snapshot(DownpourRule(), snap)
+        assert restored.last_applied == 4
+        assert restored.ps.num_commits == 4
+        assert restored.ps.epoch == 1
+        assert restored.role == "standby"
+        np.testing.assert_array_equal(restored.ps.center["w0"],
+                                      nodes[1].ps.center["w0"])
+        # the durable form feeds the postmortem's epoch cross-check
+        info_path = None
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".snap",
+                                         delete=False) as f:
+            info_path = f.name
+        checkpoint.save_ps_snapshot(info_path, snap)
+        info = checkpoint.ps_snapshot_info(info_path)
+        assert info["epoch"] == 1
+        assert info["last_acked"] == {"0": 3}
+    finally:
+        if restored is not None:
+            restored.stop()
+        _stop_all(nodes)
+
+
+def test_sharded_replicated_composition():
+    """K=4 shards under replication: every non-empty shard's commit
+    ships as its own log entry, the standby reassembles the identical
+    sharded state, and failover preserves it."""
+    center = _params(4, shapes=((3, 4), (4,), (4, 2), (2,)))
+    delta = {k: np.full_like(v, 0.25) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               num_shards=4, failover_timeout=0.4)
+    try:
+        cli = ResilientPSClient.for_replicas(
+            [n.worker_address for n in nodes], worker_id=0,
+            template=center, shards=4, retries=20,
+            backoff_base=0.05, seed=0)
+        try:
+            cli.pull()
+            for _ in range(3):
+                cli.commit(delta)
+            nodes[0].kill()
+            cli.commit(delta)
+            cli.done()
+        finally:
+            cli.close()
+        assert nodes[1].role == "primary" and nodes[1].epoch == 2
+        ps = nodes[1].ps
+        assert ps.num_commits == 4
+        assert [s.num_commits for s in ps._shards] == \
+            [4] * ps.num_shards
+        np.testing.assert_allclose(ps.center["w0"],
+                                   center["w0"] + 4 * 0.25, rtol=1e-6)
+    finally:
+        _stop_all(nodes)
+
+
+# ---- the acceptance drill ----------------------------------------------
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_chaos_kill_primary_byte_identical_center(shards, tmp_path):
+    """THE ISSUE 10 acceptance: async SOCKET training against a
+    2-node replica group, seeded chaos on the wire, primary killed
+    mid-training.  The standby self-promotes, the worker fails over,
+    and the final center is BYTE-IDENTICAL to the same run against an
+    unmolested group — the replicated dedupe table absorbed every
+    lost-ack retry exactly-once (K in {1, 4} shards)."""
+    model = ModelSpec.from_config(MLP).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+    kwargs = dict(fidelity="host", transport="socket", num_workers=1,
+                  communication_window=2, batch_size=16, num_epoch=1,
+                  learning_rate=0.01, worker_optimizer="adam",
+                  worker_retries=14, ps_shards=shards)
+
+    # uninterrupted baseline against a healthy replica group
+    base_nodes = make_replica_group(DownpourRule(), center,
+                                    replicas=2, num_shards=shards,
+                                    failover_timeout=30.0)
+    try:
+        base = DOWNPOUR(MLP, ps_replicas=[n.worker_address
+                                          for n in base_nodes],
+                        **kwargs)
+        base.train(DATA, initial_variables=variables)
+        n_rounds = len(base.history["round_loss"])
+        assert base_nodes[0].ps.num_commits == n_rounds
+        assert base.history["ps_epoch"][-1] == 1
+        base_center = jax.tree_util.tree_map(
+            np.copy, base_nodes[0].ps.center)
+    finally:
+        _stop_all(base_nodes)
+
+    # the drill: same schedule, chaos on the wire, primary killed
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               num_shards=shards,
+                               failover_timeout=0.5)
+    try:
+        def killer():
+            while nodes[0].ps.num_commits < 5:
+                time.sleep(0.002)
+            nodes[0].kill()
+
+        k = threading.Thread(target=killer)
+        k.start()
+        with ChaosTransport(seed=11, delay_rate=0.1, delay_s=0.01,
+                            reset_rate=0.05, max_injections=3,
+                            skip_ops=8) as ct:
+            t = DOWNPOUR(MLP, ps_replicas=[n.worker_address
+                                           for n in nodes], **kwargs)
+            t.train(DATA, initial_variables=variables)
+        k.join()
+        assert ct.total_injected > 0  # the chaos really fired
+        assert t.history.get("worker_round_retries"), (
+            "the kill was invisible to the worker — test proved "
+            "nothing")
+        assert t.history["ps_failovers"][-1] >= 1
+        assert t.history["ps_epoch"][-1] == 2
+        ps = nodes[1].ps
+        # exactly-once across kill + chaos: applied == rounds
+        assert len(t.history["round_loss"]) == n_rounds
+        assert ps.num_commits == n_rounds
+        # byte-identical final center vs. the unmolested run
+        for a, b in zip(jax.tree_util.tree_leaves(ps.center),
+                        jax.tree_util.tree_leaves(base_center)):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(base.trained_variables),
+                jax.tree_util.tree_leaves(t.trained_variables)):
+            np.testing.assert_array_equal(np.asarray(a),
+                                          np.asarray(b))
+    finally:
+        _stop_all(nodes)

@@ -422,10 +422,12 @@ def test_wire_ops_rejects_multibyte():
         WireOps().register("ps", b"pp", "pull")
 
 
-def test_repo_registry_covers_both_protocols():
-    assert set(WIRE_OPS.scopes()) == {"frame", "ps", "replica"}
+def test_repo_registry_covers_every_protocol():
+    assert set(WIRE_OPS.scopes()) == {"frame", "ps", "replica",
+                                      "repl"}
     assert WIRE_OPS.ops("ps")[b"p"] == "pull"
     assert WIRE_OPS.ops("replica")[b"g"] == "generate"
+    assert WIRE_OPS.ops("repl")[b"a"] == "append"
 
 
 # -- runtime lockset race + deadlock detector --------------------------
