@@ -26,18 +26,33 @@ and the bounded-staleness recovery argument of SSP/Petuum:
   promoted standby; still at-most-once, no longer exactly-once).
   Standby lag is surfaced as the ``ps_standby_lag`` gauge and flagged
   as a ``ps_replica_lag`` flight event when it crosses ``max_lag``.
-* **Epoch fencing.**  Every promotion bumps a fencing epoch stamped on
-  the replication wire.  A standby rejects log entries below its epoch
-  with the ``f`` reply; a deposed primary that comes back is fenced —
+* **Epoch fencing.**  Every promotion mints a fencing epoch stamped on
+  the replication wire.  Epochs are GLOBALLY unique: each node mints
+  the smallest value above its current epoch in its own residue class
+  (``epoch % N == index``), so standbys electing concurrently on both
+  sides of a partition can never arrive at the same epoch — one of the
+  two is always strictly newer and fences the other.  A standby
+  rejects log entries below its epoch with the ``f`` reply (a primary
+  also rejects entries AT its epoch — a second same-epoch writer is a
+  protocol violation); a deposed primary that comes back is fenced —
   its commits raise ``PSFencedError`` instead of splitting the brain —
-  and is later re-absorbed as a standby via a full bootstrap.
-* **Deterministic promotion.**  A standby that loses contact with the
-  primary for ``failover_timeout`` probes every peer before declaring
-  the primary dead (mirroring ``gateway.RemoteReplica.probe``), then
-  the winner is the highest ``(epoch, last_applied_seq)`` with ties
-  broken by address order (``elect`` — a pure function every replica
-  evaluates identically).  The winner starts serving workers on its
-  pre-reserved, advertised port — no operator action.
+  and is later re-absorbed as a standby via a full bootstrap.  Append
+  and heartbeat frames also carry the primary's promotion ``base``
+  (the seq it promoted at): a standby whose ``last_applied`` exceeds
+  the base of a newer-epoch primary holds old-epoch entries the new
+  primary will rewrite, so it demands a full resync instead of acking
+  those seqs as duplicates and silently diverging.
+* **Deterministic promotion, with quorum.**  A standby that loses
+  contact with the primary for ``failover_timeout`` probes every peer
+  before declaring it dead (mirroring ``gateway.RemoteReplica.probe``)
+  and only elects when a MAJORITY of the group is accounted for —
+  answered the probe, or confirmed dead by the host actively refusing
+  the connection.  An isolated standby's probes time out instead, so
+  it refuses to usurp a primary it merely cannot see.  The winner is
+  the highest ``(epoch, last_applied_seq)`` with ties broken by
+  address order (``elect`` — a pure function every replica evaluates
+  identically) and starts serving workers on its pre-reserved,
+  advertised port — no operator action.
 
 ``ResilientPSClient.for_replicas`` (``host_ps``) is the worker-side
 arm: an ordered replica list walked with probe-before-declare-dead, so
@@ -86,6 +101,38 @@ def elect(candidates: Sequence[tuple[int, int, int]]) -> int:
     return int(best[2])
 
 
+def probe_replica(addr: tuple[str, int], timeout: float = 0.5
+                  ) -> tuple[Optional[dict], bool]:
+    """``query_status`` plus the failure mode: ``(status,
+    confirmed_down)``.  ``confirmed_down`` is True only when the
+    peer's host actively REFUSED the connection — its kernel answered
+    but no process listens, i.e. a crash or a closed socket.  That is
+    evidence of death a silent timeout (a partition) is not, so
+    elections count refused peers toward quorum while timed-out peers
+    stay unaccounted."""
+    try:
+        sock = transport.connect(addr[0], addr[1], timeout=timeout)
+    except ConnectionRefusedError:
+        return None, True
+    except OSError:
+        return None, False
+    try:
+        sock.settimeout(timeout)
+        transport.send_msg(sock, b"?")
+        obj = transport.unpack_obj(transport.recv_msg(sock))
+        return {"epoch": int(obj["epoch"]),
+                "last_applied": int(obj["last_applied"]),
+                "role": str(obj["role"]),
+                "index": int(obj.get("index", -1))}, False
+    except (OSError, ValueError, KeyError):
+        return None, False
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
 def _ps_from_snapshot(rule: UpdateRule, snapshot: dict, *,
                       snapshot_path=None, snapshot_every: int = 0):
     """Restore the right server class from a snapshot dict (the same
@@ -107,27 +154,9 @@ def query_status(addr: tuple[str, int],
                  timeout: float = 0.5) -> Optional[dict]:
     """One replica's replication status via the ``?`` wire verb —
     ``{"epoch", "last_applied", "role", "index"}`` — or ``None`` if the
-    replica is unreachable.  This is both the election's
-    probe-before-declare-dead and the operator's peek."""
-    try:
-        sock = transport.connect(addr[0], addr[1], timeout=timeout)
-    except OSError:
-        return None
-    try:
-        sock.settimeout(timeout)
-        transport.send_msg(sock, b"?")
-        obj = transport.unpack_obj(transport.recv_msg(sock))
-        return {"epoch": int(obj["epoch"]),
-                "last_applied": int(obj["last_applied"]),
-                "role": str(obj["role"]),
-                "index": int(obj.get("index", -1))}
-    except (OSError, ValueError, KeyError):
-        return None
-    finally:
-        try:
-            sock.close()
-        except OSError:
-            pass
+    replica is unreachable.  The operator's peek; the election uses
+    ``probe_replica``, which also reports HOW the probe failed."""
+    return probe_replica(addr, timeout=timeout)[0]
 
 
 class _Link:
@@ -183,11 +212,17 @@ class Replicator:
         self.max_log = int(max_log)
         self.fenced = False  # read lock-free by the node monitor
         self.newer_epoch = int(epoch)
+        #: this primary's promotion point: every log seq above it is a
+        #: THIS-epoch entry.  Stamped on append/heartbeat frames so a
+        #: standby whose position exceeds it knows its tail belongs to
+        #: an older epoch and demands a resync instead of acking.
+        self.base = int(start_seq) - 1
         self._lock = racecheck.lock("replicated_ps.replicator")
         self._next_seq = int(start_seq)  # guarded-by: _lock
         self._log: list[tuple[int, bytes]] = []  # guarded-by: _lock
         self._links = [_Link(a, start_seq - 1) for a in standbys]
         self._lag_flagged = False  # guarded-by: _lock
+        self._unreplicated_flagged = False  # guarded-by: _lock
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -212,8 +247,31 @@ class Replicator:
                 "ps_replicated_entries_total").inc()
             if self.mode == "sync":
                 self._ship_all_locked()
+                self._flag_unreplicated_locked(seq)
             self._update_lag_locked()
         self._wake.set()
+
+    def _flag_unreplicated_locked(self, seq: int) -> None:
+        """Sync mode promises an acked commit is already on a standby;
+        when every standby is down that promise silently lapses (the
+        commit still acks — halting training on a lone survivor would
+        be worse).  Make the lapse LOUD instead of silent: count every
+        such commit and flight-record the edge, so a later bootstrap
+        rewind that loses them is attributable."""
+        if not self._links:
+            return  # replicas=1: no standbys were ever promised
+        if any(link.acked >= seq for link in self._links):
+            self._unreplicated_flagged = False
+            return
+        telemetry.metrics().counter(
+            "ps_sync_unreplicated_total").inc()
+        if not self._unreplicated_flagged:
+            self._unreplicated_flagged = True
+            # lint: allow(blocking-call-under-lock): edge-triggered
+            # (once per outage) — the guarantee lapse must reach the
+            # flight log before more unreplicated commits ack
+            flight_recorder.record("ps_sync_unreplicated",
+                                   seq=int(seq), epoch=self.epoch)
 
     def head_seq(self) -> int:
         """The last assigned log seq.  A caller holding the PS commit
@@ -325,7 +383,8 @@ class Replicator:
                 transport.send_msg(
                     link.sock,
                     b"a" + self.epoch.to_bytes(8, "big")
-                    + seq.to_bytes(8, "big"), data)
+                    + seq.to_bytes(8, "big")
+                    + self.base.to_bytes(8, "big"), data)
                 # lint: allow(blocking-call-under-lock): same contract
                 reply = transport.recv_msg(link.sock)
                 self._handle_reply_locked(link, reply)
@@ -340,7 +399,8 @@ class Replicator:
                 transport.send_msg(
                     link.sock,
                     b"h" + self.epoch.to_bytes(8, "big")
-                    + head.to_bytes(8, "big"))
+                    + head.to_bytes(8, "big")
+                    + self.base.to_bytes(8, "big"))
                 # lint: allow(blocking-call-under-lock): same contract
                 reply = transport.recv_msg(link.sock)
                 self._handle_reply_locked(link, reply)
@@ -584,14 +644,27 @@ class PSReplica:
 
     # -- promotion / demotion ------------------------------------------
 
-    def promote(self, reason: str = "manual") -> "PSReplica":
-        """Become the primary: bump the fencing epoch, start the
+    def promote(self, reason: str = "manual",
+                floor: int = 0) -> "PSReplica":
+        """Become the primary: mint a fencing epoch, start the
         worker-facing ``PSServer`` on the reserved advertised port and
-        a ``Replicator`` to every peer.  Idempotent while primary."""
+        a ``Replicator`` to every peer.  Idempotent while primary.
+
+        The mint takes the smallest value above ``max(current epoch,
+        floor)`` in THIS node's residue class (``epoch % N ==
+        index``), so epochs are globally unique: standbys electing
+        concurrently on both sides of a partition can never arrive at
+        the same epoch — equal-epoch split brain is structurally
+        impossible, and the strictly newer epoch always fences the
+        other winner.  ``floor`` lets an election pass in the highest
+        epoch it OBSERVED, so the winner's mint also dominates peers
+        it is ahead of only by hearsay."""
         with self._lock:
             if self.role == "primary" or self._stop.is_set():
                 return self
-            new_epoch = int(self.ps.epoch) + 1
+            n = max(len(self.peers), 1)
+            new_epoch = max(int(self.ps.epoch), int(floor)) + 1
+            new_epoch += (int(self.index) - new_epoch) % n
             self.ps.epoch = new_epoch
             self.ps._fenced = False
             self._diverged = False
@@ -661,8 +734,13 @@ class PSReplica:
     # -- replication listener (always on) ------------------------------
 
     def _accept_loop(self) -> None:
-        self._repl_sock.settimeout(0.2)
         try:
+            # inside the try: kill() may close the socket before this
+            # thread gets scheduled, and that race must not traceback
+            try:
+                self._repl_sock.settimeout(0.2)
+            except OSError:
+                return
             while not self._stop.is_set():
                 try:
                     conn, _ = self._repl_sock.accept()
@@ -704,25 +782,42 @@ class PSReplica:
         epoch = int.from_bytes(msg[1:9], "big")
         seq = int.from_bytes(msg[9:17], "big")
         if cmd == b"a":
-            return self._append(epoch, seq, msg[17:])
+            base = int.from_bytes(msg[17:25], "big")
+            return self._append(epoch, seq, base, msg[25:])
         if cmd == b"h":
-            return self._heartbeat(epoch, seq)
+            base = int.from_bytes(msg[17:25], "big")
+            return self._heartbeat(epoch, seq, base)
         if cmd == b"b":
             return self._bootstrap(epoch, seq, msg[17:])
         raise ValueError(f"unknown replication command {cmd!r}")
 
-    def _gate_epoch_locked(self, epoch: int,
-                           post: list) -> Optional[bytes]:
+    def _gate_epoch_locked(self, epoch: int, post: list,
+                           base: Optional[int] = None
+                           ) -> Optional[bytes]:
         """Common epoch check: fence a stale primary (reply ``f``),
         adopt a newer epoch (demoting if needed), stamp liveness.
-        Returns the fence reply, or ``None`` to proceed."""
+        Returns the fence reply, or ``None`` to proceed.
+
+        Equal epoch while THIS node is primary is also fenced: epochs
+        are minted in per-node residue classes, so a second primary at
+        our epoch is a protocol violation — refuse its stream rather
+        than apply a second writer's entries.
+
+        ``base`` (append/heartbeat frames) is the sender's promotion
+        point.  When adopting a newer epoch, a standby positioned
+        BEYOND that base holds old-epoch entries the new primary will
+        rewrite under its own epoch; acking them as duplicates would
+        fast-forward the primary past entries it never shipped here,
+        so the standby marks itself diverged and demands a resync."""
         my = int(self.ps.epoch)
-        if epoch < my:
+        if epoch < my or (epoch == my and self.role == "primary"):
             post.append(
                 lambda: self._record_fence_reject(epoch, my))
             return b"f" + my.to_bytes(8, "big")
         if epoch > my:
             self._adopt_epoch_locked(epoch, post)
+            if base is not None and int(self.last_applied) > int(base):
+                self._diverged = True
         self._last_contact = telemetry.now()
         return None
 
@@ -733,12 +828,12 @@ class PSReplica:
                                epoch=int(my_epoch),
                                stale_epoch=int(their_epoch))
 
-    def _append(self, epoch: int, seq: int,
+    def _append(self, epoch: int, seq: int, base: int,
                 data) -> tuple[bytes, list]:
         post: list = []
         entry = transport.unpack_obj(data)
         with self._lock:
-            fence = self._gate_epoch_locked(epoch, post)
+            fence = self._gate_epoch_locked(epoch, post, base=base)
             if fence is not None:
                 return fence, post
             if self._diverged:
@@ -757,11 +852,11 @@ class PSReplica:
             self.last_applied = seq
             return b"k" + seq.to_bytes(8, "big"), post
 
-    def _heartbeat(self, epoch: int,
-                   head: int) -> tuple[bytes, list]:
+    def _heartbeat(self, epoch: int, head: int,
+                   base: int) -> tuple[bytes, list]:
         post: list = []
         with self._lock:
-            fence = self._gate_epoch_locked(epoch, post)
+            fence = self._gate_epoch_locked(epoch, post, base=base)
             if fence is not None:
                 return fence, post
             if self._diverged:
@@ -842,21 +937,31 @@ class PSReplica:
     def _run_election(self) -> None:
         """The primary went quiet: probe EVERY peer before declaring it
         dead (a slow primary resets the clock), then promote the
-        deterministic winner over the reachable candidate set."""
+        deterministic winner over the reachable candidate set — but
+        only with QUORUM: a majority of the group must be accounted
+        for, i.e. answered the probe or was confirmed dead by its host
+        refusing the connection (``probe_replica``).  An isolated
+        standby's probes time out instead of refusing, so it never
+        usurps a primary it merely cannot see — and never acks commits
+        the healthy majority would later rewind."""
         with self._lock:
             my_epoch = int(self.ps.epoch)
             my_applied = int(self.last_applied)
             peers = list(self.peers)
             index = int(self.index)
         cands = [(my_epoch, my_applied, index)]
+        accounted = 1  # self
         primary_alive = False
         for i, peer in enumerate(peers):
             if i == index:
                 continue
-            st = query_status(peer["repl"],
-                              timeout=self.probe_timeout)
+            st, confirmed_down = probe_replica(
+                peer["repl"], timeout=self.probe_timeout)
             if st is None:
+                if confirmed_down:
+                    accounted += 1
                 continue
+            accounted += 1
             if st["role"] == "primary" and st["epoch"] >= my_epoch:
                 primary_alive = True
             cands.append((st["epoch"], st["last_applied"], i))
@@ -867,8 +972,18 @@ class PSReplica:
             with self._lock:
                 self._last_contact = telemetry.now()
             return
+        if 2 * accounted <= len(peers):
+            # no quorum: this node may be the isolated one — stand
+            # down and retry after another failover_timeout (the
+            # counter makes a stalled, quorum-less group diagnosable)
+            telemetry.metrics().counter(
+                "ps_election_no_quorum_total").inc()
+            with self._lock:
+                self._last_contact = telemetry.now()
+            return
         if elect(cands) == index:
-            self.promote(reason="failover")
+            self.promote(reason="failover",
+                         floor=max(c[0] for c in cands))
         else:
             # the winner gets a full failover_timeout to take over
             # before this node re-opens the election
@@ -958,7 +1073,8 @@ def make_replica_group(rule: UpdateRule, center: Pytree, *,
     """Construct and start an N-replica group in this process: every
     node gets the same ordered peer list (index order = address order =
     election tie-break order) and node 0 is promoted as the initial
-    primary (epoch 1).  Workers connect via
+    primary (epoch ``N`` — the first mint in node 0's residue class,
+    see ``PSReplica.promote``).  Workers connect via
     ``ResilientPSClient.for_replicas([n.worker_address for n in
     nodes], ...)`` — or ``trainers``' ``ps_replicas=`` — and survive a
     ``nodes[0].kill()`` without operator action."""

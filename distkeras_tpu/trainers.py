@@ -860,7 +860,8 @@ class DistributedTrainer(Trainer):
         operator action, byte-identical final center.
         ``history['ps_failovers']`` counts client-observed failovers;
         ``history['ps_epoch']`` records the serving replica's fencing
-        epoch at the end of the run.  Mutually exclusive with
+        epoch at the end of the run (``-1`` when that replica died
+        after the final pull).  Mutually exclusive with
         ``ps_address`` (a one-element list is the unreplicated
         equivalent); same contract otherwise — socket transport, the
         group outlives the driver, snapshotting configured on the
@@ -2176,9 +2177,18 @@ class DistributedTrainer(Trainer):
             try:
                 final_center = fin.pull()
                 fin.done()
+                try:
+                    served_epoch = fetch_epoch(
+                        *fin.replicas.current())
+                except OSError:
+                    # the serving replica died between the final pull
+                    # and this probe; the pull (the deliverable)
+                    # already succeeded — record the sentinel, not a
+                    # failed run
+                    served_epoch = -1
                 self._record(
                     ps_failovers=int(failover_total.value),
-                    ps_epoch=fetch_epoch(*fin.replicas.current()))
+                    ps_epoch=served_epoch)
             finally:
                 fin.close()
         else:

@@ -150,7 +150,7 @@ def failover_round(rows: int, out_dir: str) -> dict:
     assert commits == rounds, (
         f"commits lost across failover: {commits} commits for "
         f"{rounds} rounds")
-    assert t.history["ps_epoch"][-1] == epoch == 2, (
+    assert t.history["ps_epoch"][-1] == epoch == 3, (
         t.history.get("ps_epoch"), epoch)
     assert t.history["ps_failovers"][-1] >= 1, t.history
 

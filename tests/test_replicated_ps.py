@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distkeras_tpu import telemetry
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.data import datasets
 from distkeras_tpu.models import ModelSpec, model_config
@@ -75,11 +76,45 @@ def test_election_is_deterministic():
         elect([])
 
 
+def test_epoch_minting_is_globally_unique():
+    """Concurrent elections on both sides of a partition must never
+    mint the SAME epoch — the split-brain hole plain epoch fencing
+    cannot close.  Every node mints in its own residue class
+    (epoch % N == index), so successive promotions, whoever wins
+    them, produce strictly increasing and never-colliding epochs; and
+    a primary refuses a peer's stream AT its own epoch outright."""
+    center = _params(6)
+    delta = {k: np.ones_like(v) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=3,
+                               failover_timeout=30.0)
+    try:
+        assert nodes[0].epoch == 3  # bootstrap: residue 0 (mod 3)
+        # one commit ships the bootstrap epoch to every standby
+        cli = PSClient(*nodes[0].worker_address, 0, center)
+        cli.pull()
+        cli.commit(delta, seq=0)
+        cli.close()
+        assert [n.epoch for n in nodes] == [3, 3, 3]
+        nodes[1].promote(reason="manual")
+        assert nodes[1].epoch == 4  # residue 1 (mod 3), above 3
+        nodes[2].promote(reason="manual")
+        assert nodes[2].epoch == 5  # residue 2 (mod 3), above 4
+        # defensive depth: even a (protocol-impossible) equal-epoch
+        # stream is refused while this node believes itself primary
+        frame = (b"h" + nodes[2].epoch.to_bytes(8, "big")
+                 + (0).to_bytes(8, "big") + (0).to_bytes(8, "big"))
+        reply, _ = nodes[2]._dispatch_repl(frame)
+        assert reply[:1] == b"f"
+    finally:
+        _stop_all(nodes)
+
+
 # ---- replication + failover --------------------------------------------
 
 def test_kill_primary_fails_over_exactly_once():
     """Commits replicate to the standby in sync mode; killing the
-    primary promotes the standby (epoch 2) and the resilient client
+    primary promotes the standby (epoch 3 — node 1's first mint above
+    the bootstrap epoch 2) and the resilient client
     walks onto it; the replicated dedupe table keeps the total applied
     commits exactly-once, and the surviving center equals the same
     delta schedule applied to a plain single server."""
@@ -104,7 +139,7 @@ def test_kill_primary_fails_over_exactly_once():
             cli.close()
         assert cli.replicas.failovers >= 1
         assert nodes[1].role == "primary"
-        assert nodes[1].epoch == 2
+        assert nodes[1].epoch == 3
         assert nodes[1].ps.num_commits == 5  # exactly-once held
         from distkeras_tpu.parallel.host_ps import HostParameterServer
         ref = HostParameterServer(DownpourRule(), center)
@@ -160,10 +195,10 @@ def test_deposed_primary_is_fenced_and_demotes():
         c0.pull()
         c0.commit(delta, seq=0)
         nodes[1].promote(reason="manual")  # split brain, on purpose
-        assert nodes[1].epoch == 2
+        assert nodes[1].epoch == 3
         # the deposed primary notices the fence and steps down
         _wait(lambda: nodes[0].role == "standby", msg="demotion")
-        assert nodes[0].epoch == 2
+        assert nodes[0].epoch == 3
         # its worker port is back to reserved: late writers are turned
         # away at the door (refused), or fenced if they raced the
         # demotion window — either way the commit DOES NOT apply
@@ -174,7 +209,7 @@ def test_deposed_primary_is_fenced_and_demotes():
             c_late.commit(delta, seq=1)
         assert nodes[1].ps.num_commits == 1
         status = query_status(nodes[1].repl_address)
-        assert status["role"] == "primary" and status["epoch"] == 2
+        assert status["role"] == "primary" and status["epoch"] == 3
     finally:
         _stop_all(nodes)
 
@@ -203,7 +238,7 @@ def test_standby_snapshot_restart_resumes_position():
         restored = PSReplica.from_snapshot(DownpourRule(), snap)
         assert restored.last_applied == 4
         assert restored.ps.num_commits == 4
-        assert restored.ps.epoch == 1
+        assert restored.ps.epoch == 2
         assert restored.role == "standby"
         np.testing.assert_array_equal(restored.ps.center["w0"],
                                       nodes[1].ps.center["w0"])
@@ -215,7 +250,7 @@ def test_standby_snapshot_restart_resumes_position():
             info_path = f.name
         checkpoint.save_ps_snapshot(info_path, snap)
         info = checkpoint.ps_snapshot_info(info_path)
-        assert info["epoch"] == 1
+        assert info["epoch"] == 2
         assert info["last_acked"] == {"0": 3}
     finally:
         if restored is not None:
@@ -245,7 +280,7 @@ def test_sharded_replicated_composition():
             cli.done()
         finally:
             cli.close()
-        assert nodes[1].role == "primary" and nodes[1].epoch == 2
+        assert nodes[1].role == "primary" and nodes[1].epoch == 3
         ps = nodes[1].ps
         assert ps.num_commits == 4
         assert [s.num_commits for s in ps._shards] == \
@@ -254,6 +289,122 @@ def test_sharded_replicated_composition():
                                    center["w0"] + 4 * 0.25, rtol=1e-6)
     finally:
         _stop_all(nodes)
+
+
+def test_no_quorum_blocks_isolated_standby_election(monkeypatch):
+    """A standby that cannot reach ANY peer must not usurp the
+    primary: probes that TIME OUT (a partition) leave the majority
+    unaccounted, so the election stands down every cycle.  Once the
+    probe sees the dead primary's host actively REFUSE the connection
+    (a crash, not a partition), the peer counts as accounted, quorum
+    is met, and the standby promotes."""
+    from distkeras_tpu.parallel import replicated_ps as rps
+
+    center = _params(5)
+    tel = telemetry.enable()
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=0.3)
+    try:
+        ctr = tel.metrics.counter("ps_election_no_quorum_total")
+        pre = ctr.value
+        # every probe "times out": unreachable, but NOT confirmed dead
+        monkeypatch.setattr(rps, "probe_replica",
+                            lambda addr, timeout=0.5: (None, False))
+        nodes[0].kill()
+        time.sleep(1.5)  # several election timeouts' worth
+        assert nodes[1].role == "standby"  # stood down, every cycle
+        assert ctr.value > pre
+        # the partition "heals": the real probe now sees the killed
+        # primary's host refuse — confirmed death, quorum, promotion
+        monkeypatch.undo()
+        _wait(lambda: nodes[1].role == "primary",
+              msg="promotion after quorum")
+    finally:
+        _stop_all(nodes)
+        telemetry.disable()
+
+
+def test_standby_ahead_of_new_primary_is_rewound():
+    """A standby AHEAD of a newly elected primary (unreachable during
+    the election) must not ack the new primary's lower seqs as
+    duplicates — its tail holds old-epoch entries the new primary
+    will rewrite under its own epoch.  The promotion base stamped on
+    append/heartbeat frames exposes the mismatch: the standby demands
+    a full resync and converges byte-identically instead of silently
+    diverging."""
+    center = _params(7)
+    delta = {k: np.full_like(v, 0.125) for k, v in center.items()}
+    nodes = make_replica_group(DownpourRule(), center, replicas=3,
+                               failover_timeout=30.0,
+                               heartbeat_s=0.1)
+    try:
+        cli = PSClient(*nodes[0].worker_address, 0, center)
+        cli.pull()
+        cli.commit(delta, seq=0)
+        cli.commit(delta, seq=1)
+        assert [n.last_applied for n in nodes[1:]] == [2, 2]
+        # hold node 2 back: freeze the primary's maintenance thread
+        # (no revive) and down its link, then commit two more — node 1
+        # runs ahead to seq 4 while node 2 stays at 2
+        repl = nodes[0].replicator
+        repl._stop_evt.set()
+        repl._wake.set()
+        with repl._lock:
+            link = next(l for l in repl._links
+                        if l.addr == tuple(nodes[2].repl_address))
+            repl._mark_down_locked(link, ConnectionError("held back"))
+        cli.commit(delta, seq=2)
+        cli.commit(delta, seq=3)
+        cli.close()
+        assert nodes[1].last_applied == 4
+        assert nodes[2].last_applied == 2
+        nodes[0].kill()
+        # the election node 1 was unreachable for: node 2 wins anyway
+        nodes[2].promote(reason="failover")
+        _wait(lambda: (nodes[1].epoch == nodes[2].epoch
+                       and nodes[1].last_applied == 2
+                       and not nodes[1]._diverged),
+              msg="bootstrap rewind onto the new primary")
+        assert nodes[1].ps.num_commits == 2  # seqs 3, 4 are GONE
+        # and the rewound standby chains cleanly on the new epoch
+        c2 = PSClient(*nodes[2].worker_address, 0, center)
+        c2.pull()
+        c2.commit(delta, seq=4)
+        c2.close()
+        _wait(lambda: nodes[1].ps.num_commits == 3,
+              msg="catch-up after rewind")
+        for a, b in zip(jax.tree_util.tree_leaves(nodes[1].ps.center),
+                        jax.tree_util.tree_leaves(nodes[2].ps.center)):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        _stop_all(nodes)
+
+
+def test_sync_commit_with_all_standbys_down_is_flagged():
+    """Sync mode's "acked means replicated" promise lapses when every
+    standby is down; the commit still acks (halting the lone survivor
+    would be worse) but every such commit is counted, so a postmortem
+    can attribute a later rewind to the lapse window."""
+    center = _params(8)
+    delta = {k: np.ones_like(v) for k, v in center.items()}
+    tel = telemetry.enable()
+    nodes = make_replica_group(DownpourRule(), center, replicas=2,
+                               failover_timeout=30.0)
+    try:
+        cli = PSClient(*nodes[0].worker_address, 0, center)
+        cli.pull()
+        cli.commit(delta, seq=0)  # replicated: not flagged
+        ctr = tel.metrics.counter("ps_sync_unreplicated_total")
+        pre = ctr.value
+        nodes[1].kill()
+        cli.commit(delta, seq=1)  # acks, but NO standby holds it
+        cli.commit(delta, seq=2)
+        cli.close()
+        assert nodes[0].ps.num_commits == 3
+        assert ctr.value >= pre + 2
+    finally:
+        _stop_all(nodes)
+        telemetry.disable()
 
 
 # ---- the acceptance drill ----------------------------------------------
@@ -286,7 +437,7 @@ def test_chaos_kill_primary_byte_identical_center(shards, tmp_path):
         base.train(DATA, initial_variables=variables)
         n_rounds = len(base.history["round_loss"])
         assert base_nodes[0].ps.num_commits == n_rounds
-        assert base.history["ps_epoch"][-1] == 1
+        assert base.history["ps_epoch"][-1] == 2
         base_center = jax.tree_util.tree_map(
             np.copy, base_nodes[0].ps.center)
     finally:
@@ -316,7 +467,7 @@ def test_chaos_kill_primary_byte_identical_center(shards, tmp_path):
             "the kill was invisible to the worker — test proved "
             "nothing")
         assert t.history["ps_failovers"][-1] >= 1
-        assert t.history["ps_epoch"][-1] == 2
+        assert t.history["ps_epoch"][-1] == 3
         ps = nodes[1].ps
         # exactly-once across kill + chaos: applied == rounds
         assert len(t.history["round_loss"]) == n_rounds
