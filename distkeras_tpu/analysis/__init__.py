@@ -16,10 +16,22 @@ Three passes over the package, run together by
   key, and wire opcode is AST-extracted and cross-checked against
   ``docs/API.md`` and ``transport.WIRE_OPS``.
 
+A fourth pass (ISSUE 11) turns the suite inward:
+
+- :mod:`~distkeras_tpu.analysis.modelcheck` +
+  :mod:`~distkeras_tpu.analysis.protomodel` — a CHESS/DPOR-style
+  protocol model checker: exhaustive bounded exploration of the
+  replicated-PS election/fencing/replication interleavings with
+  invariant checks on every state and minimized, replayable
+  counterexamples (``scripts/check_protocol.py``).
+
 Findings are suppressed in place with ``# lint: allow(<rule>)`` (plus a
 justification) on the flagged or preceding line, or — for triaged
 intentionals that span refactors — via the committed baseline file
 ``scripts/lint_baseline.txt`` (one ``rule|path|message`` key per line).
+Suppressions themselves are linted: ``dead_suppressions`` flags
+baseline entries and allow comments no raw finding matches anymore
+(the ``dead-suppression`` rule), so the baseline cannot silently rot.
 """
 
 from __future__ import annotations
@@ -97,6 +109,62 @@ def load_baseline(path: pathlib.Path) -> set[str]:
         line = line.strip()
         if line and not line.startswith("#"):
             out.add(line)
+    return out
+
+
+RULE_DEAD = "dead-suppression"
+
+
+def dead_suppressions(raw_findings: list[Finding],
+                      sources: dict[str, list[str]],
+                      baseline: set[str]) -> list[Finding]:
+    """Suppressions that no longer suppress anything: baseline keys no
+    RAW (pre-suppression) finding produces, and ``allow(rule)``
+    comments whose covered line has no raw finding of that rule.
+    Both start as honest triage and rot into a blind spot when the
+    flagged code is fixed or moves — these findings make the rot
+    visible (``lint_static.py`` reports them; ``--strict-baseline``
+    fails on them)."""
+    out: list[Finding] = []
+
+    live_keys = {f.baseline_key() for f in raw_findings}
+    for key in sorted(baseline - live_keys):
+        path = key.split("|", 2)[1] if key.count("|") >= 2 else "?"
+        out.append(Finding(
+            RULE_DEAD, path, 0,
+            f"baseline entry matches no finding: {key}"))
+
+    by_site: dict[tuple[str, int], set[str]] = {}
+    for f in raw_findings:
+        by_site.setdefault((f.path, f.line), set()).add(f.rule)
+    for path, lines in sorted(sources.items()):
+        for idx, text in enumerate(lines):
+            m = _ALLOW_RE.search(text)
+            if not m:
+                continue
+            lineno = idx + 1
+            # a comment-only line covers the first code line below
+            # the contiguous comment block (mirrors allowed_rules'
+            # upward scan)
+            if text.lstrip().startswith("#"):
+                covered = idx + 1
+                while (covered < len(lines)
+                       and lines[covered].lstrip().startswith("#")):
+                    covered += 1
+                covered += 1  # 1-based
+            else:
+                covered = lineno
+            found = by_site.get((path, covered), set())
+            for rule in (r.strip() for r in m.group(1).split(",")):
+                # only well-formed rule names: docstrings discussing
+                # the ``allow(<rule>)`` syntax are not suppressions
+                if not re.fullmatch(r"[a-z][a-z0-9-]*", rule):
+                    continue
+                if rule not in found:
+                    out.append(Finding(
+                        RULE_DEAD, path, lineno,
+                        f"allow({rule}) suppresses nothing (no "
+                        f"{rule} finding at line {covered})"))
     return out
 
 

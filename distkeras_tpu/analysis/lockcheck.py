@@ -429,12 +429,22 @@ class _Ctx:
                        f"{held[-1]}")
             elif meth == "join" and not self._join_exempt(recv):
                 msg = f".join() while holding {held[-1]}"
-            elif meth == "wait":
+            elif meth in ("wait", "wait_for"):
+                # Condition.wait/wait_for RELEASE the condition they
+                # are called on, so waiting on the held lock itself is
+                # the intended pattern; waiting on anything else
+                # sleeps while keeping our lock
                 lid = self._lid(recv)
                 if lid is None or lid not in held:
                     what = _dotted(recv) or "<expr>"
-                    msg = (f"{what}.wait() under {held[-1]} but "
+                    msg = (f"{what}.{meth}() under {held[-1]} but "
                            f"{what} is not the held lock")
+            elif meth == "result":
+                # concurrent.futures Future.result() blocks until a
+                # worker completes — a worker that needs this lock
+                # deadlocks
+                msg = (f".result() (blocks on a future) while "
+                       f"holding {held[-1]}")
         if msg is not None:
             a.findings.append(Finding(
                 RULE_BLOCKING, self.w.mod.path, call.lineno, msg))

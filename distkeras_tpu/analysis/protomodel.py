@@ -1,0 +1,777 @@
+"""Abstract model of the replicated-PS protocol for the model checker
+(ISSUE 11 tentpole, second half).
+
+Encodes ``parallel/replicated_ps.py``'s election / fencing /
+replication protocol as :mod:`modelcheck` actors over a small explicit
+world, mirroring the real handlers function-for-function:
+
+==================  =================================================
+model function      real counterpart
+==================  =================================================
+``gate_epoch``      ``PSReplica._gate_epoch_locked`` (+ the demotion
+                    half of ``_adopt_epoch_locked``)
+``handle_append``   ``PSReplica._append``
+``handle_heartbeat````PSReplica._heartbeat``
+``handle_bootstrap````PSReplica._bootstrap``
+``monitor_tick``    ``PSReplica._monitor_tick`` / ``_run_election``
+                    (probe-then-elect with quorum; primaries send
+                    heartbeats instead)
+``promote``         ``PSReplica.promote`` — the epoch mint IS the real
+                    ``mint_epoch``; the winner rule IS the real
+                    ``elect`` (both imported, not re-implemented)
+``primary_commit``  the worker-commit + sync-``Replicator`` ship path
+                    (dedupe check first, per-standby lapse flagging)
+==================  =================================================
+
+Log entries are abstracted to ``(epoch_minted, client_seq)`` pairs —
+payload bytes don't affect the protocol, and carrying the minting
+epoch on each entry lets the prefix-agreement invariant use the Raft
+log-matching form.  Message frames keep the real wire shapes: append
+``a``/heartbeat ``h``/bootstrap ``b`` requests, ``k``/``f``/``g``
+replies, the ``g 0`` bootstrap-me sentinel (``_BOOTSTRAP_ME``), and
+the promotion ``base`` stamped on ``a``/``h``.
+
+Deliberate abstractions (documented, not accidental): probes during an
+election are atomic world reads (a cut link = timeout = unaccounted, a
+crashed host = connection refused = confirmed down); the sync
+``ack_timeout`` collapses to the moment a standby crashes or its link
+is cut (``_sever``); client retry walks replicas in address order like
+``ResilientPSClient``.
+
+Invariants (see ``INVARIANTS``): at-most-one-unfenced-primary-per-
+epoch, epoch monotonicity + global mint uniqueness, committed-log-
+prefix agreement (log matching), exactly-once application per client
+seq, and no-acked-commit-lost while a quorum of replicas holds it.
+
+The mutation harness (``MUTANTS``) flips one real guard at a time —
+drop the quorum check, naive ``max+1`` minting (with and without the
+equal-epoch fence), skip the divergence/rewind marking, don't
+replicate the dedupe table — and the explorer must produce a
+counterexample for every one.  Note the documented masking pair:
+flipping ONLY the equal-epoch fence is unobservable while residue-
+class minting holds (two nodes structurally cannot mint equal epochs),
+so the ``equal-epoch`` mutant flips the mint too — defense in depth
+means some single flips need their partner removed to show.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from distkeras_tpu.analysis.modelcheck import (
+    Choose,
+    Model,
+    Recv,
+    Step,
+    Timer,
+)
+from distkeras_tpu.parallel.replicated_ps import (
+    _BOOTSTRAP_ME,
+    elect,
+    mint_epoch,
+)
+
+# ---------------------------------------------------------------------
+# world
+
+
+class Node:
+    """One replica's protocol-visible state (mirrors ``PSReplica`` +
+    its inner PS: epoch, role, fence/diverge flags, promotion base,
+    the applied commit log and the commit-seq dedupe table)."""
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.epoch = 0
+        self.role = "standby"
+        self.crashed = False
+        self.fenced = False
+        self.diverged = False
+        self.base = 0
+        self.last_applied = 0
+        self.log: list[tuple[int, int]] = []  # (epoch_minted, cseq)
+        self.dedupe: set[int] = set()         # commit-seq dedupe table
+        self.mints: list[int] = []
+
+    def fingerprint(self):
+        return (self.epoch, self.role, self.crashed, self.fenced,
+                self.diverged, self.base, self.last_applied,
+                tuple(self.log), tuple(sorted(self.dedupe)),
+                tuple(self.mints))
+
+
+class World:
+    """Shared state all actors mutate; everything protocol-relevant is
+    here (modelcheck discipline: generator locals only drive control
+    flow) and enters the fingerprint."""
+
+    def __init__(self, n: int, commits: Sequence[int],
+                 net_script: Sequence[tuple] = (),
+                 client_cut: Sequence[int] = (),
+                 retry_budget: int = 0,
+                 mutants: Sequence[str] = ()):
+        self.n = int(n)
+        self.nodes = [Node(i) for i in range(n)]
+        self.cut: set[frozenset] = set()
+        self.client_cut = frozenset(int(i) for i in client_cut)
+        self.acked: set[int] = set()
+        self.holders: dict[int, frozenset] = {}   # cseq -> at ack time
+        self.ack_epoch: dict[int, int] = {}       # cseq -> acking epoch
+        self.missed: dict[int, set] = {}          # cseq -> lapsed peers
+        self.pending: dict[int, dict] = {}        # cseq -> sync wait
+        self.minted: list[tuple[int, int]] = []   # (epoch, node)
+        self.monotone_violation: Optional[str] = None
+        self.commits = list(commits)
+        self.net_script = list(net_script)
+        self.retry_budget = int(retry_budget)
+        self.client = {"i": 0, "p": -1, "retries": int(retry_budget)}
+        self.mutants = frozenset(mutants)
+
+    # -- topology ------------------------------------------------------
+
+    def is_cut(self, a: int, b: int) -> bool:
+        return frozenset((a, b)) in self.cut
+
+    def quorum(self) -> int:
+        return self.n // 2 + 1
+
+    def fingerprint(self):
+        return (tuple(nd.fingerprint() for nd in self.nodes),
+                tuple(sorted(tuple(sorted(p)) for p in self.cut)),
+                tuple(sorted(self.acked)),
+                tuple(sorted((k, tuple(sorted(v)))
+                             for k, v in self.holders.items())),
+                tuple(sorted(self.ack_epoch.items())),
+                tuple(sorted((k, tuple(sorted(v)))
+                             for k, v in self.missed.items())),
+                tuple(sorted(
+                    (k, v["p"], v["seq"], tuple(sorted(v["w"])))
+                    for k, v in self.pending.items())),
+                tuple(self.minted), self.monotone_violation,
+                tuple(sorted(self.client.items())))
+
+
+def _set_epoch(w: World, node: Node, epoch: int) -> None:
+    if epoch < node.epoch and w.monotone_violation is None:
+        w.monotone_violation = (f"n{node.idx} epoch {node.epoch} -> "
+                                f"{epoch}")
+    node.epoch = int(epoch)
+
+
+def _send(ctx, src: int, dst: int, msg: tuple) -> None:
+    """Deliver onto the destination's FIFO unless the link is cut or
+    the destination is dead (lossy links drop silently, like a socket
+    send into a partition)."""
+    w = ctx.world
+    if w.is_cut(src, dst) or w.nodes[dst].crashed:
+        return
+    ctx.send(("n", dst), msg)
+
+
+def _sever(w: World, peer: int) -> None:
+    """``ack_timeout`` collapsed: a standby that crashed or got cut
+    off stops being waited on — pending sync commits flag it as a
+    sync-lapse (``_flag_unreplicated_locked``) and complete."""
+    done = []
+    for cseq, rec in w.pending.items():
+        if peer in rec["w"]:
+            rec["w"].discard(peer)
+            w.missed.setdefault(cseq, set()).add(peer)
+            if not rec["w"]:
+                done.append(cseq)
+    for cseq in done:
+        p = w.pending.pop(cseq)["p"]
+        _ack(w, cseq, p)
+
+
+def _ack(w: World, cseq: int, primary: int) -> None:
+    """Server-side commit ack; remember who held the entry AT ack
+    time and under which epoch the ack was issued (the durability
+    invariant's quorum + epoch conditions).  A retry's re-ack keeps
+    the FIRST ack's record — the guarantee attached then."""
+    w.acked.add(cseq)
+    w.holders.setdefault(cseq, frozenset(
+        i for i, nd in enumerate(w.nodes)
+        if any(e[1] == cseq for e in nd.log)))
+    w.ack_epoch.setdefault(cseq, w.nodes[primary].epoch)
+
+
+# ---------------------------------------------------------------------
+# protocol handlers (mirror replicated_ps.PSReplica)
+
+
+def gate_epoch(w: World, node: Node, epoch: int,
+               base: Optional[int]) -> Optional[tuple]:
+    """``_gate_epoch_locked``: fence stale (or equal-epoch-vs-primary)
+    writers, adopt newer epochs (demoting + fencing a deposed
+    primary), mark ahead standbys diverged via the promotion base."""
+    my = node.epoch
+    if epoch < my or (epoch == my and node.role == "primary"
+                      and "equal-epoch" not in w.mutants):
+        return ("f", node.idx, my)
+    if epoch > my:
+        _set_epoch(w, node, epoch)
+        if node.role == "primary":
+            node.role = "standby"
+            node.fenced = True
+            if "skip-rewind" not in w.mutants:
+                node.diverged = True
+        if (base is not None and node.last_applied > base
+                and "skip-rewind" not in w.mutants):
+            node.diverged = True
+    return None
+
+
+def handle_append(w: World, i: int, epoch: int, seq: int, base: int,
+                  entry: tuple) -> tuple:
+    """``_append``: gate, bootstrap-me when diverged, duplicate
+    fast-forward, gap reply, or apply (entry + dedupe install)."""
+    node = w.nodes[i]
+    fence = gate_epoch(w, node, epoch, base)
+    if fence is not None:
+        return fence
+    if node.diverged:
+        return ("g", i, _BOOTSTRAP_ME)
+    if seq <= node.last_applied:
+        return ("k", i, node.last_applied)
+    if seq != node.last_applied + 1:
+        return ("g", i, node.last_applied + 1)
+    node.log.append(tuple(entry))
+    if "no-dedupe-repl" not in w.mutants:
+        node.dedupe.add(entry[1])
+    node.last_applied = seq
+    return ("k", i, seq)
+
+
+def handle_heartbeat(w: World, i: int, epoch: int, head: int,
+                     base: int) -> tuple:
+    """``_heartbeat``: gate, then report position (gap if behind)."""
+    node = w.nodes[i]
+    fence = gate_epoch(w, node, epoch, base)
+    if fence is not None:
+        return fence
+    if node.diverged:
+        return ("g", i, _BOOTSTRAP_ME)
+    if head > node.last_applied:
+        return ("g", i, node.last_applied + 1)
+    return ("k", i, node.last_applied)
+
+
+def handle_bootstrap(w: World, i: int, epoch: int, head: int,
+                     log: tuple, dedupe: tuple) -> tuple:
+    """``_bootstrap``: full-state rewind — replace log, dedupe table
+    and position wholesale; clears diverged AND the fence (the node
+    rejoins as a clean standby of the new epoch)."""
+    node = w.nodes[i]
+    fence = gate_epoch(w, node, epoch, None)
+    if fence is not None:
+        return fence
+    node.log = [tuple(e) for e in log]
+    node.dedupe = (set() if "no-dedupe-repl" in w.mutants
+                   else set(dedupe))
+    node.last_applied = int(head)
+    node.diverged = False
+    node.fenced = False
+    return ("k", i, int(head))
+
+
+def handle_reply(ctx, i: int, msg: tuple) -> None:
+    """The primary-side ``Replicator._handle_reply_locked``: ``k``
+    completes sync waits, ``f`` means a newer epoch fenced us (adopt +
+    demote), ``g`` rewinds the ship cursor (or ships a bootstrap for
+    the ``_BOOTSTRAP_ME`` sentinel)."""
+    w = ctx.world
+    node = w.nodes[i]
+    kind, src, val = msg[0], msg[1], msg[2]
+    if kind == "k":
+        done = []
+        for cseq, rec in w.pending.items():
+            if rec["p"] == i and src in rec["w"] and rec["seq"] <= val:
+                rec["w"].discard(src)
+                if not rec["w"]:
+                    done.append(cseq)
+        for cseq in done:
+            p = w.pending.pop(cseq)["p"]
+            _ack(w, cseq, p)
+        return
+    if kind == "f":
+        gate_epoch(w, node, val, None)  # adopt + demote if newer
+        return
+    if kind == "g":
+        if node.role != "primary" or node.fenced:
+            return
+        if val == _BOOTSTRAP_ME or val > len(node.log):
+            _send(ctx, i, src,
+                  ("b", i, node.epoch, node.last_applied,
+                   tuple(node.log), tuple(sorted(node.dedupe))))
+        else:
+            epoch_minted, cseq = node.log[val - 1]
+            _send(ctx, i, src,
+                  ("a", i, node.epoch, val, node.base,
+                   (epoch_minted, cseq)))
+
+
+def promote(ctx, i: int, floor: int) -> None:
+    """``PSReplica.promote``: mint in this node's residue class (the
+    REAL ``mint_epoch``), clear fence/divergence, stamp the promotion
+    base, announce to every reachable peer."""
+    w = ctx.world
+    node = w.nodes[i]
+    if node.role == "primary":
+        return
+    if "naive-mint" in w.mutants or "equal-epoch" in w.mutants:
+        new_epoch = max(node.epoch, floor) + 1
+    else:
+        new_epoch = mint_epoch(node.epoch, floor, i, w.n)
+    _set_epoch(w, node, new_epoch)
+    node.mints.append(new_epoch)
+    w.minted.append((new_epoch, i))
+    node.role = "primary"
+    node.fenced = False
+    node.diverged = False
+    node.base = node.last_applied
+    for j in range(w.n):
+        if j != i:
+            _send(ctx, i, j, ("h", i, new_epoch, node.last_applied,
+                              node.base))
+
+
+def monitor_tick(ctx, i: int) -> None:
+    """``_monitor_tick``: a primary heartbeats its peers; a standby
+    that went quiet runs ``_run_election`` — probe EVERY peer (cut
+    link = timeout = unaccounted; crashed host = connection refused =
+    accounted), stand down without quorum or if the primary answered,
+    else promote the ``elect`` winner with the observed epoch floor."""
+    w = ctx.world
+    node = w.nodes[i]
+    if node.crashed:
+        return
+    if node.role == "primary":
+        if not node.fenced:
+            for j in range(w.n):
+                if j != i:
+                    _send(ctx, i, j, ("h", i, node.epoch,
+                                      node.last_applied, node.base))
+        return
+    cands = [(node.epoch, node.last_applied, i)]
+    accounted = 1  # self
+    primary_alive = False
+    for j in range(w.n):
+        if j == i:
+            continue
+        peer = w.nodes[j]
+        if w.is_cut(i, j):
+            continue  # probe timeout: unaccounted
+        if peer.crashed:
+            accounted += 1  # connection refused: confirmed down
+            continue
+        accounted += 1
+        if peer.role == "primary" and peer.epoch >= node.epoch:
+            primary_alive = True
+        cands.append((peer.epoch, peer.last_applied, j))
+    if primary_alive:
+        return
+    if ("no-quorum" not in w.mutants
+            and 2 * accounted <= w.n):
+        return
+    if elect(cands) == i:
+        promote(ctx, i, floor=max(c[0] for c in cands))
+
+
+def primary_commit(ctx, p: int, cseq: int) -> None:
+    """One worker commit at the primary: dedupe-table check first
+    (exactly-once across retries), then apply + sync-ship to every
+    reachable standby, flagging unreachable ones as sync lapses."""
+    w = ctx.world
+    node = w.nodes[p]
+    if cseq in node.dedupe:
+        _ack(w, cseq, p)  # retried commit: already applied once
+        return
+    seq = node.last_applied + 1
+    entry = (node.epoch, cseq)
+    node.log.append(entry)
+    node.dedupe.add(cseq)
+    node.last_applied = seq
+    waiting = set()
+    for j in range(w.n):
+        if j == p:
+            continue
+        if w.nodes[j].crashed or w.is_cut(p, j):
+            w.missed.setdefault(cseq, set()).add(j)
+            continue
+        _send(ctx, p, j, ("a", p, node.epoch, seq, node.base, entry))
+        waiting.add(j)
+    if waiting:
+        w.pending[cseq] = {"p": p, "seq": seq, "w": waiting}
+    else:
+        _ack(w, cseq, p)  # total sync lapse: acked-but-flagged
+
+
+# ---------------------------------------------------------------------
+# actors
+
+
+def node_net(i: int):
+    """The replication-wire servicing loop of node ``i`` (the accept
+    thread + ``Replicator`` reply path of the real replica)."""
+
+    def actor(ctx):
+        w = ctx.world
+        while True:
+            msg = yield Recv(("n", i))
+            if w.nodes[i].crashed:
+                continue  # dead letter
+            kind, src = msg[0], msg[1]
+            if kind == "a":
+                reply = handle_append(w, i, msg[2], msg[3], msg[4],
+                                      msg[5])
+                _send(ctx, i, src, reply)
+            elif kind == "h":
+                reply = handle_heartbeat(w, i, msg[2], msg[3],
+                                         msg[4])
+                _send(ctx, i, src, reply)
+            elif kind == "b":
+                reply = handle_bootstrap(w, i, msg[2], msg[3],
+                                         msg[4], msg[5])
+                _send(ctx, i, src, reply)
+            else:  # k / f / g
+                handle_reply(ctx, i, msg)
+    return actor
+
+
+def node_timer(i: int):
+    """Node ``i``'s monitor loop: each Timer fire is one
+    ``_monitor_tick`` (heartbeat when primary, election when a quiet
+    standby — the model's Timer IS the failover timeout expiring)."""
+
+    def actor(ctx):
+        w = ctx.world
+        while True:
+            yield Timer("tick")
+            if w.nodes[i].crashed:
+                return
+            monitor_tick(ctx, i)
+    return actor
+
+
+def client_actor(ctx):
+    """``ResilientPSClient``: walk replicas in address order for an
+    unfenced primary, commit, await the sync ack, retry across
+    failover on a lost ack or a dead primary (dedupe makes the retry
+    exactly-once)."""
+    w = ctx.world
+    st = w.client
+    yield Step("start")
+    while st["i"] < len(w.commits):
+        cseq = w.commits[st["i"]]
+        p = next((j for j, nd in enumerate(w.nodes)
+                  if nd.role == "primary" and not nd.fenced
+                  and not nd.crashed and j not in w.client_cut),
+                 None)
+        if p is None:
+            yield Step("wait-primary")
+            continue
+        primary_commit(ctx, p, cseq)
+        st["p"] = p
+        while cseq not in w.acked:
+            nd = w.nodes[st["p"]]
+            if nd.crashed or nd.fenced or nd.role != "primary":
+                break  # connection died mid-commit
+            yield Step("wait-ack")
+        if cseq in w.acked and st["retries"] > 0:
+            wire = yield Choose("ackwire", ["ok", "lost"])
+            if wire == "lost":
+                st["retries"] -= 1
+                continue  # retry the SAME cseq (dedupe's job)
+        elif cseq not in w.acked:
+            if st["retries"] > 0:
+                st["retries"] -= 1
+                continue
+        st["i"] += 1
+        st["retries"] = w.retry_budget
+
+
+def net_actor(ctx):
+    """Scripted fault injection: each step cuts or heals one link at a
+    scheduler-chosen moment (the WHEN is the explored nondeterminism;
+    the WHAT is the scenario script)."""
+    w = ctx.world
+    for act, a, b in w.net_script:
+        yield Step(f"{act}:{a}-{b}")
+        pair = frozenset((a, b))
+        if act == "cut":
+            w.cut.add(pair)
+            _sever(w, a)
+            _sever(w, b)
+        else:
+            w.cut.discard(pair)
+
+
+def make_crash(i: int):
+    """Explorer-level kill of node ``i``: mark it dead, drop its
+    inbox, and complete (as lapses) any sync waits on it; a crashed
+    PRIMARY's pending commits simply never ack (the client's retry
+    path owns them)."""
+
+    def on_crash(ctx):
+        w = ctx.world
+        w.nodes[i].crashed = True
+        ctx.drain(("n", i))
+        for cseq in [c for c, rec in w.pending.items()
+                     if rec["p"] == i]:
+            del w.pending[cseq]
+        _sever(w, i)
+    return on_crash
+
+
+# ---------------------------------------------------------------------
+# invariants
+
+
+def inv_one_primary(w: World) -> Optional[str]:
+    by_epoch: dict[int, list] = {}
+    for i, nd in enumerate(w.nodes):
+        if nd.role == "primary" and not nd.fenced and not nd.crashed:
+            by_epoch.setdefault(nd.epoch, []).append(i)
+    for epoch, idxs in by_epoch.items():
+        if len(idxs) > 1:
+            return (f"nodes {idxs} are both unfenced primaries of "
+                    f"epoch {epoch}")
+    return None
+
+
+def inv_epoch_unique(w: World) -> Optional[str]:
+    if w.monotone_violation:
+        return f"epoch moved backwards: {w.monotone_violation}"
+    epochs = [e for e, _ in w.minted]
+    if len(set(epochs)) != len(epochs):
+        return f"epoch minted twice: {sorted(w.minted)}"
+    for nd in w.nodes:
+        if any(b <= a for a, b in zip(nd.mints, nd.mints[1:])):
+            return f"n{nd.idx} mints not increasing: {nd.mints}"
+    return None
+
+
+def inv_prefix_agreement(w: World) -> Optional[str]:
+    """Raft log matching: if two logs hold an entry with the same
+    (position, minting epoch), everything before it is identical —
+    the form that tolerates a stale primary's not-yet-rewound tail
+    (different epochs at the same position constrain nothing)."""
+    for a in range(w.n):
+        for b in range(a + 1, w.n):
+            la, lb = w.nodes[a].log, w.nodes[b].log
+            for k in range(min(len(la), len(lb)) - 1, -1, -1):
+                if la[k][0] == lb[k][0]:
+                    if la[:k + 1] != lb[:k + 1]:
+                        return (f"n{a}/n{b} share epoch at seq "
+                                f"{k + 1} but prefixes differ: "
+                                f"{la[:k + 1]} vs {lb[:k + 1]}")
+                    break
+    return None
+
+
+def inv_exactly_once(w: World) -> Optional[str]:
+    for nd in w.nodes:
+        seen = [e[1] for e in nd.log]
+        if len(set(seen)) != len(seen):
+            return (f"n{nd.idx} applied a commit twice: log "
+                    f"{nd.log}")
+    return None
+
+
+def inv_durability(w: World) -> Optional[str]:
+    """No acked commit that a QUORUM held at ack time may be missing
+    from any unfenced primary AT OR ABOVE the acking epoch.  Two
+    documented exemptions: sub-quorum acks are the sync-lapse
+    degradation, and a stale LOWER-epoch primary is the tolerated
+    split-brain transient — it gets fenced on first contact, and a
+    quorum election can never seat a >=-epoch primary without the
+    commit (the winner maximizes ``last_applied`` over a majority
+    that intersects the holders)."""
+    q = w.quorum()
+    primaries = [nd for nd in w.nodes
+                 if nd.role == "primary" and not nd.fenced
+                 and not nd.crashed]
+    for cseq in w.acked:
+        if len(w.holders.get(cseq, frozenset())) < q:
+            continue
+        for nd in primaries:
+            if nd.epoch < w.ack_epoch.get(cseq, 0):
+                continue
+            if all(e[1] != cseq for e in nd.log):
+                return (f"acked commit {cseq} (quorum-held at ack) "
+                        f"is missing from primary n{nd.idx} "
+                        f"epoch {nd.epoch}")
+    return None
+
+
+INVARIANTS = [
+    ("one-primary-per-epoch", inv_one_primary),
+    ("epoch-unique-monotone", inv_epoch_unique),
+    ("prefix-agreement", inv_prefix_agreement),
+    ("exactly-once", inv_exactly_once),
+    ("durable-acked-commits", inv_durability),
+]
+
+
+# ---------------------------------------------------------------------
+# scenarios
+
+
+def _base_world(n: int, **kw) -> World:
+    """n0 is the bootstrapped primary (its mint recorded), peers are
+    caught-up standbys of its epoch — the post-``make_replica_group``
+    steady state every scenario starts from."""
+    w = World(n, **kw)
+    e0 = mint_epoch(0, 0, 0, n)
+    n0 = w.nodes[0]
+    _set_epoch(w, n0, e0)
+    n0.role = "primary"
+    n0.mints.append(e0)
+    w.minted.append((e0, 0))
+    for nd in w.nodes[1:]:
+        _set_epoch(w, nd, e0)
+    return w
+
+
+def _seed_commit(w: World, cseq: int,
+                 holders: Sequence[int]) -> None:
+    """Pre-apply an acked commit on ``holders`` (scenario setup:
+    shrinks the schedule prefix the explorer must wade through)."""
+    for i in holders:
+        nd = w.nodes[i]
+        nd.log.append((w.nodes[0].epoch, cseq))
+        nd.dedupe.add(cseq)
+        nd.last_applied += 1
+    w.acked.add(cseq)
+    w.holders[cseq] = frozenset(holders)
+    w.ack_epoch[cseq] = w.nodes[0].epoch
+    missing = set(range(w.n)) - set(holders)
+    if missing:
+        w.missed[cseq] = missing
+
+
+def _assemble(make_world, *, crashable=(), timers=(0, 1, 2),
+              timer_budget=2, crash_budget=1) -> Model:
+    probe = make_world()
+    n = probe.n
+    m = Model(make_world)
+    for i in range(n):
+        m.actor(f"n{i}", node_net(i))
+    for i in timers:
+        m.actor(f"n{i}.t", node_timer(i))
+    if probe.commits:
+        m.actor("client", client_actor)
+    if probe.net_script:
+        m.actor("net", net_actor)
+    for i in crashable:
+        m.allow_crash(f"n{i}", make_crash(i), budget=crash_budget)
+    m.timer_budget = int(timer_budget)
+    for name, fn in INVARIANTS:
+        m.invariant(name, fn)
+    return m
+
+
+def scenario_failover(mutants=()) -> tuple[Model, dict]:
+    """Primary crash + quorum re-election + client retry across the
+    boundary: the exactly-once / dedupe-replication story."""
+    muts = tuple(mutants)
+
+    def make_world():
+        w = _base_world(3, commits=[1], retry_budget=1,
+                        mutants=muts)
+        return w
+    model = _assemble(make_world, crashable=(0,), timers=(1, 2),
+                      timer_budget=2)
+    return model, {"max_depth": 18, "max_states": 150_000}
+
+
+def scenario_partition(mutants=()) -> tuple[Model, dict]:
+    """A standby isolated by a partition while commits flow on the
+    majority side: the quorum story (the minority must stand down)."""
+    muts = tuple(mutants)
+
+    def make_world():
+        w = _base_world(3, commits=[2],
+                        net_script=[("cut", 0, 2), ("cut", 1, 2)],
+                        client_cut=(2,), mutants=muts)
+        _seed_commit(w, 1, (0, 1, 2))
+        return w
+    model = _assemble(make_world, crashable=(), timers=(2,),
+                      timer_budget=2)
+    return model, {"max_depth": 14, "max_states": 150_000}
+
+
+def scenario_split(mutants=()) -> tuple[Model, dict]:
+    """Primary dead AND the two standbys partitioned from each other:
+    concurrent elections on both sides — the residue-class epoch
+    uniqueness story."""
+    muts = tuple(mutants)
+
+    def make_world():
+        w = _base_world(3, commits=[],
+                        net_script=[("cut", 1, 2)], mutants=muts)
+        _seed_commit(w, 1, (0, 1, 2))
+        return w
+    model = _assemble(make_world, crashable=(0,), timers=(1, 2),
+                      timer_budget=2)
+    return model, {"max_depth": 12, "max_states": 150_000}
+
+
+def scenario_rewind(mutants=()) -> tuple[Model, dict]:
+    """An isolated old primary with an unreplicated tail vs a freshly
+    elected majority primary, links healing mid-stream: the
+    divergence / bootstrap-rewind story.  Starts mid-partition with
+    the lapsed tail already applied (seeded) so the explorer spends
+    its depth on the interesting part."""
+    muts = tuple(mutants)
+
+    def make_world():
+        w = _base_world(3, commits=[3, 4],
+                        net_script=[("heal", 0, 1), ("heal", 0, 2)],
+                        client_cut=(0,), mutants=muts)
+        _seed_commit(w, 1, (0, 1, 2))
+        # the old primary's isolated, sync-lapsed tail
+        _seed_commit(w, 2, (0,))
+        w.cut.add(frozenset((0, 1)))
+        w.cut.add(frozenset((0, 2)))
+        return w
+    model = _assemble(make_world, crashable=(), timers=(1,),
+                      timer_budget=3)
+    return model, {"max_depth": 22, "max_states": 400_000}
+
+
+SCENARIOS = {
+    "failover": scenario_failover,
+    "partition": scenario_partition,
+    "split": scenario_split,
+    "rewind": scenario_rewind,
+}
+
+#: mutant -> (guard it flips, scenario that exposes it, invariant
+#: expected to break).  Every entry must yield a counterexample.
+MUTANTS = {
+    "no-quorum": ("election promotes without a majority accounted",
+                  "partition", "durable-acked-commits"),
+    "naive-mint": ("max+1 epoch mint instead of residue classes",
+                   "split", "one-primary-per-epoch"),
+    "equal-epoch": ("naive mint AND equal-epoch frames accepted "
+                    "(the fence alone is masked by residue minting)",
+                    "split", "one-primary-per-epoch"),
+    "skip-rewind": ("ahead standby acks a new primary's seqs as "
+                    "duplicates instead of demanding a resync",
+                    "rewind", "prefix-agreement"),
+    "no-dedupe-repl": ("replication installs entries but not the "
+                       "commit-seq dedupe table",
+                       "failover", "exactly-once"),
+}
+
+
+def build(scenario: str, mutants: Sequence[str] = ()
+          ) -> tuple[Model, dict]:
+    """Scenario name (+ optional mutant set) -> (Model, explorer
+    bounds)."""
+    unknown = set(mutants) - set(MUTANTS)
+    if unknown:
+        raise KeyError(f"unknown mutants: {sorted(unknown)}")
+    return SCENARIOS[scenario](mutants=tuple(mutants))

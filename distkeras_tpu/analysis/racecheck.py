@@ -443,6 +443,20 @@ class Guarded:
         self._rc_check(f"[{k!r}]", write=True)
         self._rc_obj[k] = v
 
+    def __delitem__(self, k):
+        # dunders bypass __getattr__ (special-method lookup goes to
+        # the type), so deletion needs its own interception or it
+        # escapes the lockset algorithm entirely
+        self._rc_check(f"[{k!r}]", write=True)
+        del self._rc_obj[k]
+
+    def pop(self, *args, **kwargs):
+        # ditto for pop: via __getattr__ it records a READ of "pop",
+        # not the mutation of the popped key
+        field = f"[{args[0]!r}]" if args else "pop"
+        self._rc_check(field, write=True)
+        return self._rc_obj.pop(*args, **kwargs)
+
     def __len__(self):
         self._rc_check("__len__", write=False)
         return len(self._rc_obj)

@@ -101,6 +101,20 @@ def elect(candidates: Sequence[tuple[int, int, int]]) -> int:
     return int(best[2])
 
 
+def mint_epoch(current: int, floor: int, index: int,
+               group: int) -> int:
+    """Pure residue-class epoch mint: the smallest value strictly above
+    ``max(current, floor)`` with ``epoch % group == index``.  Epochs
+    are therefore globally unique across the group — two nodes can
+    never mint the same value, so equal-epoch split brain is
+    structurally impossible (``promote`` uses this; the protocol model
+    in ``analysis.protomodel`` imports it rather than re-deriving)."""
+    n = max(int(group), 1)
+    epoch = max(int(current), int(floor)) + 1
+    epoch += (int(index) - epoch) % n
+    return epoch
+
+
 def probe_replica(addr: tuple[str, int], timeout: float = 0.5
                   ) -> tuple[Optional[dict], bool]:
     """``query_status`` plus the failure mode: ``(status,
@@ -267,7 +281,7 @@ class Replicator:
             "ps_sync_unreplicated_total").inc()
         if not self._unreplicated_flagged:
             self._unreplicated_flagged = True
-            # lint: allow(blocking-call-under-lock): edge-triggered
+            # blocking by design: edge-triggered
             # (once per outage) — the guarantee lapse must reach the
             # flight log before more unreplicated commits ack
             flight_recorder.record("ps_sync_unreplicated",
@@ -301,7 +315,7 @@ class Replicator:
         self.fenced = True
         self.newer_epoch = max(self.newer_epoch, int(their_epoch))
         telemetry.metrics().counter("ps_fenced_total").inc()
-        # lint: allow(blocking-call-under-lock): the fencing decision
+        # blocking by design: the fencing decision
         # must hit the flight log before any caller observes it — this
         # is the split-brain postmortem's key event
         flight_recorder.record("ps_fenced", role="primary",
@@ -322,7 +336,7 @@ class Replicator:
 
     def _ensure_sock_locked(self, link: _Link) -> None:
         if link.sock is None:
-            # lint: allow(blocking-call-under-lock): sync ack mode —
+            # blocking by design: sync ack mode —
             # the commit's reply must not escape before the standbys
             # ack, so the ship (connect included) happens under the
             # lock by design; ack_timeout bounds the stall
@@ -377,7 +391,7 @@ class Replicator:
                 if data is None:
                     link.needs_bootstrap = True
                     break
-                # lint: allow(blocking-call-under-lock): sync ack mode
+                # blocking by design: sync ack mode
                 # ships inside the commit lock by design (see
                 # _ensure_sock_locked); ack_timeout bounds the stall
                 transport.send_msg(
@@ -385,7 +399,7 @@ class Replicator:
                     b"a" + self.epoch.to_bytes(8, "big")
                     + seq.to_bytes(8, "big")
                     + self.base.to_bytes(8, "big"), data)
-                # lint: allow(blocking-call-under-lock): same contract
+                # blocking by design: same contract
                 reply = transport.recv_msg(link.sock)
                 self._handle_reply_locked(link, reply)
                 guard += 1
@@ -394,14 +408,14 @@ class Replicator:
                         "standby not converging (gap loop)")
             if heartbeat and not link.needs_bootstrap:
                 head = self._next_seq - 1
-                # lint: allow(blocking-call-under-lock): heartbeat on
+                # blocking by design: heartbeat on
                 # the maintenance thread; ack_timeout bounds the stall
                 transport.send_msg(
                     link.sock,
                     b"h" + self.epoch.to_bytes(8, "big")
                     + head.to_bytes(8, "big")
                     + self.base.to_bytes(8, "big"))
-                # lint: allow(blocking-call-under-lock): same contract
+                # blocking by design: same contract
                 reply = transport.recv_msg(link.sock)
                 self._handle_reply_locked(link, reply)
         except PSFencedError:
@@ -421,7 +435,7 @@ class Replicator:
         telemetry.metrics().gauge("ps_standby_lag").set(lag)
         if lag > self.max_lag and not self._lag_flagged:
             self._lag_flagged = True
-            # lint: allow(blocking-call-under-lock): edge-triggered
+            # blocking by design: edge-triggered
             # (once per breach) — the lag breach must reach the flight
             # log even if the primary dies right after
             flight_recorder.record("ps_replica_lag", lag=int(lag),
@@ -662,9 +676,8 @@ class PSReplica:
         with self._lock:
             if self.role == "primary" or self._stop.is_set():
                 return self
-            n = max(len(self.peers), 1)
-            new_epoch = max(int(self.ps.epoch), int(floor)) + 1
-            new_epoch += (int(self.index) - new_epoch) % n
+            new_epoch = mint_epoch(int(self.ps.epoch), int(floor),
+                                   int(self.index), len(self.peers))
             self.ps.epoch = new_epoch
             self.ps._fenced = False
             self._diverged = False

@@ -10,6 +10,8 @@ unsuppressed findings:
 Suppression is in-source (``# lint: allow(<rule>)`` on or above the
 flagged line) or via the committed baseline ``scripts/lint_baseline.txt``
 (``Finding.baseline_key`` lines — rule|path|message, line-number-free).
+Suppressions that no longer match any finding are themselves reported
+(rule ``dead-suppression``; report-only unless ``--strict-baseline``).
 
 Finding counts are emitted as ``lint_findings_total{rule=...}`` through
 the telemetry registry; ``--metrics-out`` writes the registry snapshot
@@ -27,6 +29,7 @@ sys.path.insert(0, str(REPO))
 
 from distkeras_tpu import telemetry  # noqa: E402
 from distkeras_tpu.analysis import (  # noqa: E402
+    dead_suppressions,
     filter_suppressed,
     load_baseline,
     lockcheck,
@@ -39,7 +42,9 @@ BASELINE = REPO / "scripts" / "lint_baseline.txt"
 
 
 def run_lint(baseline_path: pathlib.Path = BASELINE):
-    """All passes -> (unsuppressed findings, counts-by-rule, stats)."""
+    """All passes -> (unsuppressed findings, counts-by-rule, stats).
+    ``stats["dead"]`` carries the dead-suppression findings, reported
+    separately so the caller decides whether they gate."""
     paths = package_files(REPO)
     sources = read_sources(REPO, paths)
     findings = lockcheck.analyze_paths(REPO, paths)
@@ -48,11 +53,13 @@ def run_lint(baseline_path: pathlib.Path = BASELINE):
     baseline = load_baseline(baseline_path)
     final = [f for f in kept if f.baseline_key() not in baseline]
     n_baselined = len(kept) - len(final)
+    dead = dead_suppressions(findings, sources, baseline)
     counts: dict[str, int] = {}
-    for f in final:
+    for f in final + dead:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     stats = {"files": len(paths), "raw": len(findings),
-             "allowed": n_allowed, "baselined": n_baselined}
+             "allowed": n_allowed, "baselined": n_baselined,
+             "dead": dead}
     return final, counts, stats
 
 
@@ -115,6 +122,17 @@ def self_check() -> list[str]:
         'm.counter("bogus_metric_zzz").inc()', "fixture.py")
     expect([surfaces.RULE_METRIC],
            surfaces.check_docs(s, docs="(empty)"), "undoc-metric")
+    from distkeras_tpu.analysis import Finding, RULE_DEAD
+    fixture_src = ("x = 1  # lint: allow(bogus-rule)\n"
+                   "y = 2\n")
+    dead = dead_suppressions(
+        [Finding("other-rule", "fixture.py", 2, "m")],
+        {"fixture.py": fixture_src.splitlines()},
+        {"stale-rule|gone.py|old message"})
+    expect([RULE_DEAD, RULE_DEAD], dead, "dead-suppression")
+    if len(dead) != 2:
+        failures.append(f"dead-suppression: expected a dead allow "
+                        f"AND a dead baseline entry, got {dead}")
     s = surfaces.extract_source(
         'transport.send_msg(sock, b"Z")', "fixture.py",
         wire_scope="ps")
@@ -128,6 +146,9 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="full lint + seeded-violation self-check")
     ap.add_argument("--baseline", default=str(BASELINE))
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="exit 2 on dead suppressions (baseline "
+                         "entries / allow comments matching nothing)")
     ap.add_argument("--metrics-out", default=None,
                     help="write the telemetry registry snapshot here")
     args = ap.parse_args(argv)
@@ -137,10 +158,14 @@ def main(argv=None) -> int:
 
     for f in findings:
         print(f)
+    dead = stats["dead"]
+    for f in dead:
+        print(f"{f}{'' if args.strict_baseline else '  (report-only)'}")
     print(f"lint_static: {stats['files']} files, "
           f"{len(findings)} unsuppressed finding(s) "
           f"({stats['allowed']} allowed in-source, "
-          f"{stats['baselined']} baselined)")
+          f"{stats['baselined']} baselined, "
+          f"{len(dead)} dead suppression(s))")
 
     if args.smoke:
         failures = self_check()
@@ -151,7 +176,9 @@ def main(argv=None) -> int:
         print("lint_static: self-check OK (all rules fire on seeded "
               "violations)")
 
-    return 2 if findings else 0
+    if findings:
+        return 2
+    return 2 if (args.strict_baseline and dead) else 0
 
 
 if __name__ == "__main__":

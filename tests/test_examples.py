@@ -25,6 +25,7 @@ SCRIPTS = REPO / "scripts"
 # at tiny CPU shapes — the same no-silent-rot contract as CASES.
 SMOKE_SCRIPTS = {
     "chaos_report.py": ["--smoke"],
+    "check_protocol.py": ["--smoke"],
     "lint_static.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],

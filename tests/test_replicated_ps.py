@@ -22,6 +22,7 @@ from distkeras_tpu.parallel.host_ps import (PSClient, PSFencedError,
                                             ResilientPSClient)
 from distkeras_tpu.parallel.replicated_ps import (PSReplica, elect,
                                                   make_replica_group,
+                                                  mint_epoch,
                                                   query_status)
 from distkeras_tpu.parallel.update_rules import DownpourRule
 from distkeras_tpu.trainers import DOWNPOUR
@@ -74,6 +75,51 @@ def test_election_is_deterministic():
     assert elect([(3, 4, 1)]) == 1
     with pytest.raises(ValueError, match="at least one"):
         elect([])
+
+
+def test_election_invariant_under_candidate_order():
+    """``elect()`` is the agreement point of the whole failover
+    protocol: every standby runs it over whatever candidate subset it
+    probed, in whatever order replies arrived.  Exhaustively: for every
+    2-node and 3-node (epoch, last_applied_seq) tie pattern and EVERY
+    permutation of the candidate list, the winner is identical — and it
+    is the max by (epoch, last_applied, lowest index), i.e. the same
+    pure function the protocol model checker imports
+    (analysis/protomodel)."""
+    import itertools
+
+    # every tie pattern over {distinct-low, distinct-high, tied}: 3
+    # values per axis cover all equality relations among <=3 nodes
+    axis = (0, 1, 1)  # includes a duplicated value -> true ties
+    for n in (2, 3):
+        for epochs in itertools.product(axis, repeat=n):
+            for seqs in itertools.product(axis, repeat=n):
+                cands = [(epochs[i], seqs[i], i) for i in range(n)]
+                expected = max(
+                    cands, key=lambda c: (c[0], c[1], -c[2]))[2]
+                for perm in itertools.permutations(cands):
+                    got = elect(list(perm))
+                    assert got == expected, (
+                        f"elect{tuple(perm)} = {got}, "
+                        f"want {expected} (from {cands})")
+
+
+def test_mint_epoch_residue_unique_and_monotone():
+    """``mint_epoch`` is pure: for every (current, floor) pair each
+    index mints in its own residue class (epoch % N == index), strictly
+    above both inputs — so concurrent elections on both sides of a
+    partition can never collide, whatever each side last saw."""
+    for group in (2, 3, 5):
+        for current in range(0, 12):
+            for floor in range(0, 12):
+                minted = [mint_epoch(current, floor, i, group)
+                          for i in range(group)]
+                assert len(set(minted)) == group  # pairwise distinct
+                for i, e in enumerate(minted):
+                    assert e % group == i
+                    assert e > current and e > floor
+                    # re-minting from the result stays monotone
+                    assert mint_epoch(e, floor, i, group) > e
 
 
 def test_epoch_minting_is_globally_unique():

@@ -15,8 +15,10 @@ import threading
 import pytest
 
 from distkeras_tpu.analysis import (
+    RULE_DEAD,
     Finding,
     allowed_rules,
+    dead_suppressions,
     filter_suppressed,
     load_baseline,
     lockcheck,
@@ -109,6 +111,78 @@ def test_try_finally_release_tracks_held_region():
         """)
     assert len(fs) == 1 and fs[0].rule == lockcheck.RULE_BLOCKING
     assert fs[0].line == 10  # the sleep inside the held region
+
+
+def test_wait_for_on_foreign_lock_fires():
+    """``Condition.wait_for`` blocks exactly like ``wait``: calling it
+    on anything other than the HELD lock sleeps inside someone else's
+    critical section."""
+    fs = _lint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def poke(self):
+                with self._lock:
+                    self._cv.wait_for(lambda: True)
+        """)
+    assert _rules(fs) == {lockcheck.RULE_BLOCKING}
+    assert "wait_for" in fs[0].message
+
+
+def test_wait_for_on_held_condition_is_clean():
+    """``wait_for`` on the held condition RELEASES it while sleeping —
+    the one blocking call that is correct under its own lock."""
+    fs = _lint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def poke(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: True)
+        """)
+    assert fs == []
+
+
+def test_future_result_under_lock_fires():
+    """``.result()`` parks the thread until another thread completes
+    the future — a classic lock-held stall (and deadlock, if the
+    completing thread needs the lock)."""
+    fs = _lint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self, fut):
+                with self._lock:
+                    return fut.result()
+        """)
+    assert _rules(fs) == {lockcheck.RULE_BLOCKING}
+    assert ".result()" in fs[0].message
+
+
+def test_future_result_outside_lock_is_clean():
+    fs = _lint("""\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self, fut):
+                with self._lock:
+                    pass
+                return fut.result()
+        """)
+    assert fs == []
 
 
 def test_lock_order_inversion_fires():
@@ -304,6 +378,50 @@ def test_baseline_roundtrip(tmp_path):
 def test_finding_str_is_clickable():
     f = Finding("lock-order", "pkg/mod.py", 42, "boom")
     assert str(f) == "pkg/mod.py:42: [lock-order] boom"
+
+
+# -- dead-suppression lint ---------------------------------------------
+
+
+def test_dead_baseline_entry_is_flagged():
+    f = Finding("lock-order", "pkg/mod.py", 3, "live")
+    dead = dead_suppressions(
+        [f], {}, {f.baseline_key(), "lock-order|pkg/gone.py|fixed"})
+    assert [d.rule for d in dead] == [RULE_DEAD]
+    assert "pkg/gone.py" in dead[0].message
+    assert dead[0].path == "pkg/gone.py"  # clickable at the dead entry
+
+
+def test_dead_allow_comment_is_flagged_live_is_not():
+    src = ("x = 1  # lint: allow(lock-order)\n"       # dead: no finding
+           "y = 2  # lint: allow(guarded-write)\n")   # live
+    dead = dead_suppressions(
+        [Finding("guarded-write", "pkg/mod.py", 2, "m")],
+        {"pkg/mod.py": src.splitlines()}, set())
+    assert len(dead) == 1
+    assert dead[0].line == 1 and "allow(lock-order)" in dead[0].message
+
+
+def test_dead_allow_block_comment_covers_code_line_below():
+    """A comment-block allow covers the first code line below it —
+    live when that line has the finding, dead otherwise."""
+    src = ("# justification wraps over\n"
+           "# lint: allow(lock-order)\n"
+           "x = blocking()\n")
+    lines = src.splitlines()
+    live = dead_suppressions(
+        [Finding("lock-order", "p.py", 3, "m")], {"p.py": lines}, set())
+    assert live == []
+    dead = dead_suppressions([], {"p.py": lines}, set())
+    assert len(dead) == 1 and dead[0].line == 2
+
+
+def test_docstring_allow_placeholder_is_not_a_suppression():
+    """Prose discussing the ``allow(<rule>)`` syntax must not be
+    treated as a suppression (rule names are [a-z0-9-] words)."""
+    src = ('"""Suppress with # lint: allow(<rule>) or allow(...)."""\n'
+           "x = 1\n")
+    assert dead_suppressions([], {"d.py": src.splitlines()}, set()) == []
 
 
 # -- surface-drift lints: seeded violations ----------------------------
@@ -506,6 +624,33 @@ def test_guarded_reports_access_without_declared_lock(rc):
     reports = rc.disable()
     assert any(r.kind == "unguarded" and "table" in r.detail
                for r in reports)
+
+
+def test_guarded_intercepts_delitem_and_pop(rc):
+    """Regression: special-method lookup goes to the TYPE, so ``del
+    d[k]`` never reached ``__getattr__`` — deletions escaped the
+    lockset machinery entirely (and ``pop`` recorded a READ of the
+    method name, not a write of the popped key).  Both must now report
+    unguarded writes of the KEY when the declared lock is not held."""
+    lk = rc.lock("del.demo")
+    with lk:  # populate under the lock: setup itself stays clean
+        backing = {"a": 1, "b": 2, "c": 3}
+        shared = rc.Guarded(backing, lock=lk, name="table")
+    del shared["a"]       # naked deletion
+    assert shared.pop("b") == 2   # naked pop
+    assert "a" not in backing and "b" not in backing  # still functional
+    reports = rc.disable()
+    naked = [r for r in reports if r.kind == "unguarded"]
+    assert any("['a']" in r.detail for r in naked), \
+        [str(r) for r in reports]
+    assert any("['b']" in r.detail for r in naked), \
+        [str(r) for r in reports]
+    # the same operations under the declared lock are clean
+    rc.enable()
+    with lk:
+        del shared["c"]
+        shared.pop("missing", None)
+    assert rc.disable() == []
 
 
 def test_seeded_two_lock_deadlock_raises_not_hangs(rc):
