@@ -28,6 +28,7 @@ RULE_SPAN = "undocumented-span"
 RULE_FLIGHT = "undocumented-flight-kind"
 RULE_SLO = "undocumented-slo-signal"
 RULE_HISTORY = "undocumented-history-key"
+RULE_TIER = "undocumented-tier"
 RULE_OPCODE = "unregistered-opcode"
 
 #: wire modules and the WIRE_OPS protocol scope their byte literals
@@ -52,13 +53,15 @@ class Surface:
     flight_kinds: dict[str, _Site] = field(default_factory=dict)
     slo_signals: dict[str, _Site] = field(default_factory=dict)
     history_keys: dict[str, _Site] = field(default_factory=dict)
+    #: ``fidelity=`` lowering tiers (``TIERS`` registry keys)
+    tiers: dict[str, _Site] = field(default_factory=dict)
     # scope -> opcode byte -> site
     wire_ops: dict[str, dict[bytes, _Site]] = field(
         default_factory=dict)
 
     def merge(self, other: "Surface") -> None:
         for name in ("metrics", "spans", "flight_kinds",
-                     "slo_signals", "history_keys"):
+                     "slo_signals", "history_keys", "tiers"):
             mine, theirs = getattr(self, name), getattr(other, name)
             for k, site in theirs.items():
                 mine.setdefault(k, site)
@@ -115,6 +118,13 @@ def extract_source(src: str, path: str,
                 if isinstance(k, ast.Constant):
                     s.slo_signals.setdefault(
                         k.value, (path, k.lineno))
+        elif (isinstance(node, ast.Assign)
+              and any(isinstance(t, ast.Name) and t.id == "TIERS"
+                      for t in node.targets)
+              and isinstance(node.value, ast.Dict)):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant):
+                    s.tiers.setdefault(k.value, (path, k.lineno))
         elif (wire_scope is not None
               and isinstance(node, ast.Constant)
               and isinstance(node.value, bytes)
@@ -182,13 +192,23 @@ def documented_history_keys(docs: str) -> set[str]:
     return set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
 
 
+def documented_tiers(docs: str) -> set[str]:
+    """First-column names of the 'Lowering tiers' table."""
+    m = re.search(r"### Lowering tiers(.*?)(?:\n## |\Z)", docs, re.S)
+    if not m:
+        return set()
+    return set(re.findall(r"^\| `([a-z_]+)` \|", m.group(1), re.M))
+
+
 def check_docs(surface: Surface, docs: str) -> list[Finding]:
     """Every extracted name must appear in docs/API.md: metrics and
     span names anywhere as a whole word, flight kinds and SLO signals
-    as table rows, history keys as rows of the history-key table."""
+    as table rows, history keys as rows of the history-key table,
+    lowering tiers as rows of the 'Lowering tiers' table."""
     out: list[Finding] = []
     rows = _table_rows(docs)
     hist = documented_history_keys(docs)
+    tier_rows = documented_tiers(docs)
     for name, (path, line) in sorted(surface.metrics.items()):
         if not _word_in(name, docs):
             out.append(Finding(
@@ -219,6 +239,12 @@ def check_docs(surface: Surface, docs: str) -> list[Finding]:
                 RULE_HISTORY, path, line,
                 f"history key {name!r} recorded but missing from the "
                 f"docs/API.md 'Trainer history keys' table"))
+    for name, (path, line) in sorted(surface.tiers.items()):
+        if name not in tier_rows:
+            out.append(Finding(
+                RULE_TIER, path, line,
+                f"lowering tier {name!r} registered but has no row "
+                f"in the docs/API.md 'Lowering tiers' table"))
     return out
 
 

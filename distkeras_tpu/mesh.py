@@ -227,3 +227,14 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (batch) axis across workers."""
     return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def shardings_for(mesh: Mesh, specs) -> "jax.tree_util.PyTreeDef":
+    """``PartitionSpec`` pytree -> ``NamedSharding`` pytree on ``mesh``.
+
+    The placement half of the regex-rule resolver
+    (``parallel.ps_dataplane.match_partition_rules``): rules produce
+    specs, this binds them to devices."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))

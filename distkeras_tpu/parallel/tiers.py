@@ -1,0 +1,96 @@
+"""Lowering tiers: the fidelity registry (ISSUE 12 satellite).
+
+``fidelity=`` used to be validated by ad-hoc string checks scattered
+across ``trainers.py`` (``fidelity != "host"``, ``not in ("faithful",
+...)``); every new arm meant hunting them all down.  This table is the
+one place a tier's capabilities live — trainers resolve the string
+once and gate each feature on a capability flag, so adding a tier
+touches one row and every error message can list the valid choices.
+
+The tiers are *lowerings* of the same PS-round semantics:
+
+* ``host`` — the host-wire control+data plane: free-running worker
+  threads racing against a concurrent host parameter server (real TCP
+  optional).  Nondeterministic by design; the arm chaos/replication/
+  snapshot suites run on.
+* ``faithful`` / ``fast`` — the on-mesh *emulated* rounds
+  (``ps_emulator``): one XLA program per round, commits serialized by
+  a seeded permutation (faithful scans them; fast collapses the
+  linear rules to a closed form).
+* ``mesh`` — the on-chip compiled data plane (``ps_dataplane``): one
+  SPMD shard_map program per round with the center *sharded* over the
+  ``workers`` axis, delta reduction lowered to reduce-scatter, and
+  donated state buffers.  Implements the ``fast`` tier's closed-form
+  center trajectory (same seeded ``commit_permutation``), plus a
+  pipelined variant matching ``make_pipelined_round_fn``'s +W offset.
+
+``analysis/surfaces.py`` cross-checks the ``TIERS`` keys against the
+docs/API.md "Lowering tiers" table, so a tier added here without docs
+fails ``lint_static.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class LoweringTier:
+    """Capabilities of one ``fidelity=`` lowering tier."""
+
+    name: str
+    #: "host-wire" (threads + transport), "emulated" (one XLA program
+    #: per round, stacked workers), or "mesh" (one SPMD shard_map
+    #: program per round, sharded center)
+    data_plane: str
+    #: real concurrency (racing threads): gates the host-only kwargs
+    #: (transport/fault injection/compression/external PS/shards/...)
+    concurrent: bool
+    #: bit-replayable under a fixed seed
+    deterministic: bool
+    #: supports commit_overlap=True (a commit phase that can pipeline
+    #: against the next window)
+    commit_overlap: bool
+    #: supports model_parallel > 1 (tensor-parallel worker programs)
+    model_parallel: bool
+    #: supports checkpoint/resume of mid-training state
+    checkpoint: bool
+
+
+TIERS = {
+    "host": LoweringTier(
+        name="host", data_plane="host-wire", concurrent=True,
+        deterministic=False, commit_overlap=True, model_parallel=False,
+        checkpoint=False),
+    "faithful": LoweringTier(
+        name="faithful", data_plane="emulated", concurrent=False,
+        deterministic=True, commit_overlap=True, model_parallel=True,
+        checkpoint=True),
+    "fast": LoweringTier(
+        name="fast", data_plane="emulated", concurrent=False,
+        deterministic=True, commit_overlap=False, model_parallel=True,
+        checkpoint=True),
+    "mesh": LoweringTier(
+        name="mesh", data_plane="mesh", concurrent=False,
+        deterministic=True, commit_overlap=True, model_parallel=False,
+        checkpoint=False),
+}
+
+
+def valid_tiers() -> list[str]:
+    return sorted(TIERS)
+
+
+def tiers_with(capability: str) -> list[str]:
+    """Tier names whose ``capability`` flag is set — for error messages
+    that must tell the user which fidelities DO support a feature."""
+    return sorted(n for n, t in TIERS.items()
+                  if getattr(t, capability))
+
+
+def resolve_tier(name: str) -> LoweringTier:
+    if name not in TIERS:
+        raise ValueError(
+            f"unknown fidelity {name!r}; valid lowering tiers: "
+            f"{valid_tiers()}")
+    return TIERS[name]

@@ -43,8 +43,9 @@ from distkeras_tpu import telemetry
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import ModelSpec
-from distkeras_tpu.parallel import tensor_parallel
+from distkeras_tpu.parallel import ps_dataplane, tensor_parallel
 from distkeras_tpu.parallel.ps_emulator import make_round_fn
+from distkeras_tpu.parallel.tiers import resolve_tier, tiers_with
 from distkeras_tpu.parallel.update_rules import (
     AdagRule,
     DownpourRule,
@@ -885,6 +886,9 @@ class DistributedTrainer(Trainer):
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
+        # one registry validates every fidelity and names its
+        # capabilities — feature gates below read flags, not strings
+        self.tier = resolve_tier(fidelity)
         self.fidelity = fidelity
         self.transport = transport
         self.checkpoint_every_rounds = checkpoint_every_rounds
@@ -898,11 +902,13 @@ class DistributedTrainer(Trainer):
         if self.model_parallel < 1:
             raise ValueError(
                 f"model_parallel must be >= 1, got {model_parallel}")
-        if self.model_parallel > 1 and fidelity == "host":
+        if self.model_parallel > 1 and not self.tier.model_parallel:
             raise ValueError(
-                "model_parallel > 1 needs the on-mesh emulated "
-                "fidelities (the host arm's workers are per-thread "
-                "device programs, DP-only)")
+                f"model_parallel > 1 is unsupported on the "
+                f"{fidelity!r} tier (host workers are per-thread "
+                f"device programs and the mesh tier maps one worker "
+                f"per device — both DP-only); tensor-parallel tiers: "
+                f"{tiers_with('model_parallel')}")
         self.compression = compression
         if compression is not None:
             from distkeras_tpu.parallel.compression import resolve_codec
@@ -931,23 +937,24 @@ class DistributedTrainer(Trainer):
                 f"ps_shards must be >= 1, got {ps_shards}")
         self.ps_snapshot_path = ps_snapshot_path
         self.ps_snapshot_every = int(ps_snapshot_every)
-        if fidelity != "host" and (self.max_worker_failures
-                                   or self.worker_retries
-                                   or self.worker_timeout is not None
-                                   or fault_injector is not None
-                                   or compression is not None
-                                   or ps_address is not None
-                                   or ps_replicas is not None
-                                   or self.ps_shards > 1
-                                   or ps_snapshot_path is not None
-                                   or self.ps_snapshot_every):
+        if not self.tier.concurrent and (self.max_worker_failures
+                                         or self.worker_retries
+                                         or self.worker_timeout is not None
+                                         or fault_injector is not None
+                                         or compression is not None
+                                         or ps_address is not None
+                                         or ps_replicas is not None
+                                         or self.ps_shards > 1
+                                         or ps_snapshot_path is not None
+                                         or self.ps_snapshot_every):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
                 "/ fault_injector / compression / ps_address / "
                 "ps_replicas / ps_shards / ps_snapshot_* apply only to "
-                "fidelity='host' (the emulated arms are deterministic; "
-                "recover via checkpoint/resume), got "
-                f"fidelity={fidelity!r}")
+                "fidelity='host' (the compiled tiers are "
+                "deterministic; recover via checkpoint/resume), got "
+                f"fidelity={fidelity!r}; concurrent tiers: "
+                f"{tiers_with('concurrent')}")
         if ps_address is not None and transport != "socket":
             raise ValueError(
                 "ps_address attaches to an external PSServer over TCP; "
@@ -973,14 +980,15 @@ class DistributedTrainer(Trainer):
                 "PSReplica nodes, not on the trainer (the driver does "
                 "not own the replica group)")
         self.commit_overlap = bool(commit_overlap)
-        if self.commit_overlap and fidelity not in ("faithful",
-                                                    "host"):
+        if self.commit_overlap and not self.tier.commit_overlap:
             raise ValueError(
                 "commit_overlap pipelines the commit against the next "
-                "window; it requires fidelity='faithful' (pipelined "
-                "round scan) or fidelity='host' (double-buffered "
-                "worker loop) — the fast arm has no separate commit "
-                f"phase to overlap, got fidelity={fidelity!r}")
+                "window; it needs a tier with a separate commit phase "
+                "(faithful's pipelined round scan, mesh's overlapped "
+                "reduce-scatter, host's double-buffered worker loop) "
+                "— the fast arm's closed form has none, got "
+                f"fidelity={fidelity!r}; overlap-capable tiers: "
+                f"{tiers_with('commit_overlap')}")
         if self.commit_overlap and (checkpoint_every_rounds
                                     or kwargs.get("checkpoint_dir")):
             raise ValueError(
@@ -1056,13 +1064,20 @@ class DistributedTrainer(Trainer):
         raise NotImplementedError
 
     def _train(self, dataset, initial_variables, resume_from=None):
-        if self.fidelity == "host":
-            if resume_from or self.checkpoint_dir:
+        tier = self.tier
+        if not tier.checkpoint and (resume_from or self.checkpoint_dir):
+            if tier.name == "host":
                 raise NotImplementedError(
                     "fidelity='host' is the nondeterministic faithful "
                     "arm; checkpoint/resume of racing threads is not "
                     "supported — use the emulated fidelities")
+            raise NotImplementedError(
+                f"fidelity={tier.name!r} does not checkpoint its "
+                f"sharded-center layout; checkpointing tiers: "
+                f"{tiers_with('checkpoint')}")
+        if tier.data_plane == "host-wire":
             return self._train_host(dataset, initial_variables)
+        mesh_tier = tier.data_plane == "mesh"
         rule = self.allocate_rule()
         tx = self._tx()
         variables = self._init_variables(initial_variables)
@@ -1128,13 +1143,14 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     "commit_overlap supports data-parallel workers "
                     "only (model_parallel=1)")
-            from distkeras_tpu.parallel.ps_emulator import (
-                flush_pending, make_pipelined_round_fn)
+            if not mesh_tier:
+                from distkeras_tpu.parallel.ps_emulator import (
+                    flush_pending, make_pipelined_round_fn)
 
-            round_fn = make_pipelined_round_fn(rule, step)
-            flush_fn = functools.partial(flush_pending, rule,
-                                         num_workers=num_workers)
-        else:
+                round_fn = make_pipelined_round_fn(rule, step)
+                flush_fn = functools.partial(flush_pending, rule,
+                                             num_workers=num_workers)
+        elif not mesh_tier:
             round_fn = make_round_fn(rule, step, self.fidelity)
         ps_state = rule.init_state(center)
         perm_key = jax.random.key(self.seed + 2)
@@ -1177,11 +1193,33 @@ class DistributedTrainer(Trainer):
                 "multi-host needs one mesh slot per worker "
                 f"({num_workers} workers over "
                 f"{len(jax.devices())} global devices)")
+        if mesh_tier:
+            if pc > 1:
+                raise NotImplementedError(
+                    "fidelity='mesh' is single-process for now (the "
+                    "sharded-center programs assume one controller) — "
+                    "use fidelity='faithful'/'fast' for multi-host")
+            if placement.mesh is None or placement.vmap_workers != 1:
+                raise ValueError(
+                    f"fidelity='mesh' maps one worker per device over "
+                    f"the {mesh_lib.WORKER_AXIS!r} mesh axis; "
+                    f"num_workers={num_workers} does not fit "
+                    f"{len(jax.devices())} devices — use "
+                    f"fidelity='fast' for vmap-folded workers")
         if placement.mesh is not None:
             m = placement.mesh
             rep = NamedSharding(m, P())
             row = NamedSharding(m, P(mesh_lib.WORKER_AXIS))
-            if mp > 1:
+            if mesh_tier:
+                # On-chip compiled data plane: the whole round is one
+                # SPMD shard_map program with the center sharded over
+                # the worker axis; states move into its packed layout
+                # here and stay on device (donated) between rounds.
+                dp = ps_dataplane.MeshDataplane(
+                    rule, step, m, center, pipelined=overlap)
+                ps_state, worker_states = dp.to_device(
+                    ps_state, worker_states)
+            elif mp > 1:
                 # PS center sharded by the TP specs (worker states were
                 # born sharded above; a msgpack resume replaced them
                 # with host arrays, which round_jit's in_shardings
@@ -1212,7 +1250,11 @@ class DistributedTrainer(Trainer):
                 perm_key = jax.random.wrap_key_data(jnp.asarray(
                     np.asarray(cursor.pop("perm_key_data"),
                                np.uint32)))
-            if overlap:
+            if mesh_tier:
+                round_jit = dp.round
+                if overlap:
+                    flush_jit = dp.flush
+            elif overlap:
                 round_jit = jax.jit(
                     round_fn,
                     in_shardings=(ps_sharding, ws_sharding, row, rep,
@@ -1255,8 +1297,11 @@ class DistributedTrainer(Trainer):
             # delta (inert for the delta family) until the first round
             # marks it valid; pend_live mirrors validity host-side so
             # the epoch-end flush doesn't fetch a device bool
-            pend_payloads = jax.tree_util.tree_map(
-                jnp.zeros_like, worker_states.params)
+            if mesh_tier:
+                pend_payloads = dp.init_pending()
+            else:
+                pend_payloads = jax.tree_util.tree_map(
+                    jnp.zeros_like, worker_states.params)
             if placement.mesh is not None:
                 pend_perm = mesh_lib.global_batch_from_local(
                     rep, np.arange(num_workers, dtype=np.int32))
@@ -1482,7 +1527,8 @@ class DistributedTrainer(Trainer):
                          segment_stall_s=round(seg_stall, 4))
             if getattr(self, "_eval_dataset", None) is not None:
                 self._eval_epoch({
-                    "params": ps_state.center,
+                    "params": (dp.center(ps_state) if mesh_tier
+                               else ps_state.center),
                     **slice_row0(worker_states.model_state)})
             save_point({"epoch": epoch + 1, "round": 0})
             telemetry.complete("epoch", t_epoch, epoch=epoch,
@@ -1492,9 +1538,13 @@ class DistributedTrainer(Trainer):
         # (replicated output) so only one row ever crosses to host.
         final_model_state = jax.tree_util.tree_map(
             mesh_lib.fetch, slice_row0(worker_states.model_state))
-        self.trained_variables = {"params": ps_state.center,
+        # Mesh tier: unpack the sharded-center layout back into the
+        # public PSState shape callers (and save()) expect.
+        ps_export = (dp.export_ps_state(ps_state) if mesh_tier
+                     else ps_state)
+        self.trained_variables = {"params": ps_export.center,
                                   **final_model_state}
-        self.parameter_server_state = jax.device_get(ps_state)
+        self.parameter_server_state = jax.device_get(ps_export)
         return self.trained_variables
 
 

@@ -138,6 +138,10 @@ def self_check() -> list[str]:
         wire_scope="ps")
     expect([surfaces.RULE_OPCODE], surfaces.check_opcodes(s),
            "unregistered-opcode")
+    s = surfaces.extract_source(
+        'TIERS = {"bogus_tier": None}', "fixture.py")
+    expect([surfaces.RULE_TIER],
+           surfaces.check_docs(s, docs="(empty)"), "undoc-tier")
     return failures
 
 

@@ -31,6 +31,7 @@ SMOKE_SCRIPTS = {
     "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
     "perf_prefix.py": ["--smoke"],
+    "perf_ps_flagship.py": ["--smoke"],
     "perf_regress.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],

@@ -1,0 +1,286 @@
+"""On-chip compiled PS data plane (ISSUE 12): mesh-tier parity against
+the emulated closed form, the one-compile-per-round-shape guard, the
+partition-rule resolver, and the tier registry's validation surface.
+
+Parity runs on the MLP: matmuls are batching-stable on CPU, so the
+mesh tier's per-device window must match the emulated tier's vmapped
+window to float tolerance.  (Convs are NOT batching-stable on the CPU
+backend — the flagship smoke documents that.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import mesh as mesh_lib
+from distkeras_tpu import telemetry
+from distkeras_tpu.data import datasets
+from distkeras_tpu.models import model_config
+from distkeras_tpu.parallel import ps_dataplane
+from distkeras_tpu.parallel.ps_emulator import (
+    commit_permutation,
+    flush_pending,
+    make_pipelined_round_fn,
+    make_round_fn,
+)
+from distkeras_tpu.parallel.tiers import TIERS, resolve_tier, tiers_with
+from distkeras_tpu.parallel.update_rules import RULES
+from distkeras_tpu.trainers import AEASGD, DOWNPOUR
+from distkeras_tpu.workers import (
+    TrainState,
+    make_train_step,
+    resolve_optimizer,
+)
+from jax.sharding import PartitionSpec as P
+
+MLP = model_config("mlp", (8,), num_classes=4, hidden=(32,))
+DATA = datasets.synthetic_classification(2048, (8,), 4, seed=0)
+
+
+def _setup(rule_name, W, rounds=3, window=2, batch=4):
+    """Shared harness: model, rule, seeded batches/permutations, and
+    fresh emulated + mesh states started from the same center."""
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = Tiny()
+    tx = resolve_optimizer("momentum", 0.05)
+    rule = RULES[rule_name]()
+    variables = model.init(jax.random.key(0), jnp.ones((2, 8)))
+    center = variables["params"]
+    step = make_train_step(model, "sparse_categorical_crossentropy", tx)
+
+    def make_worker(rng):
+        return TrainState.create({"params": center}, tx, rng)
+
+    keys = jax.random.split(jax.random.key(1), W)
+    rngd = np.random.RandomState(0)
+    batches = [
+        {"features": jnp.asarray(rngd.randn(W, window, batch, 8),
+                                 jnp.float32),
+         "label": jnp.asarray(rngd.randint(0, 4, (W, window, batch)),
+                              jnp.int32)}
+        for _ in range(rounds)]
+    pkey = jax.random.key(2)
+    perms = []
+    for _ in range(rounds):
+        pkey, sub = jax.random.split(pkey)
+        perms.append(commit_permutation(sub, W))
+    ws = jax.vmap(make_worker)(keys)
+    ps = rule.init_state(center)
+    return rule, step, center, ws, ps, batches, perms, make_worker, keys
+
+
+def _assert_tree_close(a, b, msg=""):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-5, atol=1e-6, err_msg=msg)
+
+
+@pytest.mark.parametrize("rule_name", ["downpour", "adag", "dynsgd"])
+@pytest.mark.parametrize("W", [2, 4])
+def test_mesh_round_matches_fast(rule_name, W):
+    (rule, step, center, ws, ps, batches, perms, make_worker,
+     keys) = _setup(rule_name, W)
+    rf = jax.jit(make_round_fn(rule, step, "fast"))
+    ref_metrics = []
+    for b, p in zip(batches, perms):
+        ps, ws, met = rf(ps, ws, b, p)
+        ref_metrics.append(jax.device_get(met))
+
+    placement = mesh_lib.place_workers(W)
+    dp = ps_dataplane.MeshDataplane(rule, step, placement.mesh, center)
+    mps, mws = dp.to_device(rule.init_state(center),
+                            jax.vmap(make_worker)(keys))
+    row = mesh_lib.batch_sharding(placement.mesh)
+    rep = mesh_lib.replicated_sharding(placement.mesh)
+    for (b, p), ref in zip(zip(batches, perms), ref_metrics):
+        mps, mws, met = dp.round(mps, mws,
+                                 jax.device_put(b, row),
+                                 jax.device_put(p, rep))
+        _assert_tree_close(ref["loss"], met["loss"], rule_name)
+        _assert_tree_close(ref["grad_norm"], met["grad_norm"],
+                           rule_name)
+        np.testing.assert_array_equal(np.asarray(ref["staleness"]),
+                                      np.asarray(met["staleness"]))
+    assert int(mps.clock) == int(ps.clock)
+    _assert_tree_close(ps.center, dp.center(mps), rule_name)
+    # exported state round-trips into the public PSState shape
+    exported = dp.export_ps_state(mps)
+    _assert_tree_close(ps.center, exported.center)
+    assert int(exported.clock) == int(ps.clock)
+
+
+@pytest.mark.parametrize("rule_name", ["downpour", "adag", "dynsgd"])
+@pytest.mark.parametrize("W", [2, 4])
+def test_mesh_pipelined_matches_emulated(rule_name, W):
+    """The +W-offset pipelined contract, including the final
+    ``flush_pending`` drain of the carried commit."""
+    (rule, step, center, ws, ps, batches, perms, make_worker,
+     keys) = _setup(rule_name, W)
+    rf = jax.jit(make_pipelined_round_fn(rule, step))
+    pend = jax.tree_util.tree_map(jnp.zeros_like, ws.params)
+    pperm, valid = jnp.arange(W), jnp.asarray(False)
+    ref_metrics = []
+    for b, p in zip(batches, perms):
+        ps, ws, met, pend, pperm, valid = rf(ps, ws, b, p, pend,
+                                             pperm, valid)
+        ref_metrics.append(jax.device_get(met))
+    ps = flush_pending(rule, ps, pend, pperm, W)
+
+    placement = mesh_lib.place_workers(W)
+    dp = ps_dataplane.MeshDataplane(rule, step, placement.mesh, center,
+                                    pipelined=True)
+    mps, mws = dp.to_device(rule.init_state(center),
+                            jax.vmap(make_worker)(keys))
+    row = mesh_lib.batch_sharding(placement.mesh)
+    rep = mesh_lib.replicated_sharding(placement.mesh)
+    mpend = dp.init_pending()
+    mpperm = jax.device_put(jnp.arange(W, dtype=jnp.int32), rep)
+    mvalid = jax.device_put(jnp.asarray(False), rep)
+    for (b, p), ref in zip(zip(batches, perms), ref_metrics):
+        mps, mws, met, mpend, mpperm, mvalid = dp.round(
+            mps, mws, jax.device_put(b, row), jax.device_put(p, rep),
+            mpend, mpperm, mvalid)
+        _assert_tree_close(ref["loss"], met["loss"], rule_name)
+        np.testing.assert_array_equal(np.asarray(ref["staleness"]),
+                                      np.asarray(met["staleness"]))
+    mps = dp.flush(mps, mpend, mpperm)
+    assert int(mps.clock) == int(ps.clock)
+    _assert_tree_close(ps.center, dp.center(mps), rule_name)
+
+
+def test_one_compiled_program_per_round_shape():
+    """The public trace counter proves the whole round is ONE compiled
+    program reused across rounds; a new worker count is a new shape
+    and exactly one more trace."""
+    tel = telemetry.enable()
+    try:
+        for i, W in enumerate((4, 2)):
+            (rule, step, center, ws, ps, batches, perms, make_worker,
+             keys) = _setup("dynsgd", W)
+            placement = mesh_lib.place_workers(W)
+            dp = ps_dataplane.MeshDataplane(rule, step, placement.mesh,
+                                            center)
+            mps, mws = dp.to_device(rule.init_state(center),
+                                    jax.vmap(make_worker)(keys))
+            row = mesh_lib.batch_sharding(placement.mesh)
+            rep = mesh_lib.replicated_sharding(placement.mesh)
+            for b, p in zip(batches, perms):
+                mps, mws, _ = dp.round(mps, mws,
+                                       jax.device_put(b, row),
+                                       jax.device_put(p, rep))
+            counters = tel.metrics.snapshot()["counters"]
+            key = 'ps_round_compiles_total{fidelity="mesh"}'
+            assert counters.get(key) == i + 1, counters
+    finally:
+        telemetry.disable()
+
+
+def test_trainer_mesh_matches_fast_end_to_end():
+    def run(fidelity, **kw):
+        t = DOWNPOUR(MLP, fidelity=fidelity, num_workers=4,
+                     communication_window=4, batch_size=32,
+                     num_epoch=1, learning_rate=0.005, seed=3, **kw)
+        return t, t.train(DATA)
+
+    tf_, vf = run("fast")
+    tm, vm = run("mesh")
+    _assert_tree_close(vf["params"], vm["params"])
+    assert tf_.history["staleness"] == tm.history["staleness"]
+    np.testing.assert_allclose(tf_.history["round_loss"],
+                               tm.history["round_loss"],
+                               rtol=2e-5, atol=1e-6)
+    _assert_tree_close(tf_.parameter_server_state.center,
+                       tm.parameter_server_state.center)
+    assert int(tf_.parameter_server_state.clock) == \
+        int(tm.parameter_server_state.clock)
+
+
+def test_trainer_mesh_overlap_matches_faithful_pipelined():
+    def run(fidelity):
+        t = DOWNPOUR(MLP, fidelity=fidelity, num_workers=4,
+                     communication_window=4, batch_size=32,
+                     num_epoch=1, learning_rate=0.005, seed=3,
+                     commit_overlap=True)
+        return t, t.train(DATA)
+
+    tf_, vf = run("faithful")
+    tm, vm = run("mesh")
+    _assert_tree_close(vf["params"], vm["params"])
+    assert tf_.history["staleness"] == tm.history["staleness"]
+
+
+# ---- partition-rule resolver ------------------------------------------
+
+def test_match_partition_rules_regex_and_scalars():
+    tree = {"dense": {"kernel": jnp.zeros((4, 8)),
+                      "bias": jnp.zeros((8,))},
+            "scale": jnp.zeros(())}
+    specs = ps_dataplane.match_partition_rules(
+        ((r".*bias", P()), (r".*", P(mesh_lib.WORKER_AXIS))), tree)
+    assert specs["dense"]["kernel"] == P(mesh_lib.WORKER_AXIS)
+    assert specs["dense"]["bias"] == P()
+    assert specs["scale"] == P()  # scalars never shard
+
+
+def test_match_partition_rules_unmatched_leaf_raises():
+    with pytest.raises(ValueError, match="dense/kernel"):
+        ps_dataplane.match_partition_rules(
+            ((r"nothing", P()),), {"dense": {"kernel": jnp.zeros((4,))}})
+
+
+# ---- tier registry + trainer validation -------------------------------
+
+def test_tier_registry():
+    assert set(TIERS) == {"host", "faithful", "fast", "mesh"}
+    assert resolve_tier("mesh").data_plane == "mesh"
+    with pytest.raises(ValueError, match="valid lowering tiers"):
+        resolve_tier("bogus")
+    assert tiers_with("deterministic") == ["faithful", "fast", "mesh"]
+    assert tiers_with("concurrent") == ["host"]
+
+
+def test_unknown_fidelity_lists_tiers():
+    with pytest.raises(ValueError, match="valid lowering tiers"):
+        DOWNPOUR(MLP, fidelity="bogus", num_workers=2,
+                 learning_rate=0.005)
+
+
+def test_mesh_tier_rejects_checkpointing():
+    t = DOWNPOUR(MLP, fidelity="mesh", num_workers=2, batch_size=32,
+                 communication_window=2, num_epoch=1,
+                 learning_rate=0.005, checkpoint_dir="/tmp/never")
+    with pytest.raises(NotImplementedError, match="checkpointing "
+                                                  "tiers"):
+        t.train(DATA)
+
+
+def test_mesh_tier_rejects_model_parallel():
+    with pytest.raises(ValueError, match="tensor-parallel tiers"):
+        DOWNPOUR(MLP, fidelity="mesh", num_workers=2, model_parallel=2,
+                 learning_rate=0.005)
+
+
+def test_mesh_tier_needs_one_device_per_worker():
+    t = DOWNPOUR(MLP, fidelity="mesh", num_workers=16, batch_size=8,
+                 communication_window=2, num_epoch=1,
+                 learning_rate=0.003)
+    with pytest.raises(ValueError, match="does not fit"):
+        t.train(DATA)
+
+
+def test_mesh_tier_rejects_elastic_family():
+    t = AEASGD(MLP, fidelity="mesh", num_workers=2, batch_size=32,
+               communication_window=2, num_epoch=1,
+               learning_rate=0.005)
+    with pytest.raises(ValueError, match="elastic"):
+        t.train(DATA)

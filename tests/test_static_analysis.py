@@ -485,6 +485,17 @@ def test_undocumented_history_key_fires():
     assert [f for f in fs if "bogus_key" in f.message]
 
 
+def test_undocumented_tier_needs_a_table_row():
+    s = surfaces.extract_source(
+        'TIERS = {"bogus_tier": None}\n', "fix.py")
+    # a loose mention is NOT enough — tiers need a Lowering-tiers row
+    fs = surfaces.check_docs(s, "the bogus_tier lowering")
+    assert _rules(fs) == {surfaces.RULE_TIER}
+    docs = ("### Lowering tiers\n\n"
+            "| `bogus_tier` | emulated | yes | all |\n")
+    assert surfaces.check_docs(s, docs) == []
+
+
 def test_unregistered_opcode_fires():
     s = surfaces.extract_source(
         'def f(sock):\n    sock.sendall(b"Z")\n',
