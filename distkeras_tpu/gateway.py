@@ -9,10 +9,13 @@ closes (the serving-side mirror of what ``ResilientPSClient`` /
 
 * **Routing** — ``ServingGateway`` spreads requests over K replicas
   under a pluggable policy: ``round_robin`` (fair under uniform
-  traffic), ``least_loaded`` (queue-depth + slot-occupancy aware, the
-  right default under ragged decode lengths), or ``session`` (sticky
-  key-hash affinity, so a conversation keeps hitting the replica that
-  holds its KV prefix warm).
+  traffic), ``least_loaded`` (queue-depth + slot-occupancy aware,
+  breaking ties on the paged engines' ``free_pages`` headroom so
+  paged replicas absorb bursts first — envelope replicas fall back
+  to queue depth alone; the right default under ragged decode
+  lengths), or ``session`` (sticky key-hash affinity, so a
+  conversation keeps hitting the replica that holds its KV prefix
+  warm).
 * **Failover** — a replica erroring, shedding, or dying mid-stream
   does not fail the request: the gateway reschedules it onto another
   replica under the same seeded full-jitter backoff discipline as
@@ -198,6 +201,12 @@ class EngineReplica:
             return len(self._pending) + sum(
                 1 for c in self._mailbox if c[0] == "submit")
 
+    def free_pages(self) -> Optional[int]:
+        """Free device KV pages on a paged engine (``None``: envelope
+        pools) — ``least_loaded``'s tie-break signal."""
+        fn = getattr(self.engine, "free_pages", None)
+        return fn() if callable(fn) else None
+
     def dispatch(self, spec: Mapping, on_result: Callable) -> None:
         """Enqueue one request; ``on_result(result_or_exception)``
         fires exactly once from the driver thread."""
@@ -245,6 +254,7 @@ class EngineReplica:
         if not self._alive:
             return {"alive": False, "state": "down", "load": 0}
         return {"alive": True, "load": self.load(),
+                "free_pages": self.free_pages(),
                 **self.engine.health()}
 
     # -- driver -------------------------------------------------------
@@ -290,7 +300,8 @@ class EngineReplica:
             return
         _, spec, cb = cmd
         kwargs = {}
-        for k in ("max_new_tokens", "eos_id", "deadline", "meta"):
+        for k in ("max_new_tokens", "eos_id", "deadline", "meta",
+                  "tenant", "priority"):
             if k in spec:
                 kwargs[k] = spec[k]
         try:
@@ -542,6 +553,7 @@ class RemoteReplica:
         self._lock = racecheck.lock("gateway.remote")
         self._alive = True  # guarded-by: _lock
         self._outstanding = 0  # guarded-by: _lock
+        self._free_pages = None  # last health-reported page headroom
 
     def start(self) -> "RemoteReplica":
         return self  # the server owns the engine lifecycle
@@ -552,6 +564,13 @@ class RemoteReplica:
 
     def load(self) -> int:
         return self._outstanding
+
+    def free_pages(self) -> Optional[int]:
+        """Page headroom as of the last ``health()``/``probe()``
+        round-trip (``None`` until one lands, or for envelope-pool
+        servers) — a cached snapshot, not a live read: routing must
+        not pay an RPC per choice."""
+        return self._free_pages
 
     def _exchange(self, cmd: bytes, body: bytes = b"",
                   timeout: Optional[float] = None):
@@ -588,11 +607,13 @@ class RemoteReplica:
         """One health round-trip; revives a down-marked proxy when the
         server is reachable again (the warm-restart story)."""
         try:
-            self._exchange(b"h", timeout=self.connect_timeout)
+            out = self._exchange(b"h", timeout=self.connect_timeout)
         except (ConnectionError, OSError, ValueError):
             return False
         with self._lock:  # revival races dispatch's _mark_down
             self._alive = True
+            if isinstance(out, Mapping):
+                self._free_pages = out.get("free_pages")
         return True
 
     def dispatch(self, spec: Mapping, on_result: Callable) -> None:
@@ -642,10 +663,14 @@ class RemoteReplica:
 
     def health(self) -> dict:
         try:
-            return self._exchange(b"h",
-                                  timeout=self.connect_timeout)
+            out = self._exchange(b"h",
+                                 timeout=self.connect_timeout)
         except (ConnectionError, OSError, ValueError):
             return {"alive": False, "state": "down", "load": 0}
+        if isinstance(out, Mapping):
+            with self._lock:
+                self._free_pages = out.get("free_pages")
+        return out
 
     def stop_server(self) -> None:
         with contextlib.suppress(ConnectionError, OSError):
@@ -832,11 +857,14 @@ class ServingGateway:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                eos_id=_UNSET, request_id=None, deadline=_UNSET,
-               session=None, meta: Optional[Mapping] = None):
+               session=None, meta: Optional[Mapping] = None,
+               tenant=None, priority: Optional[int] = None):
         """Queue one request; returns its id.  ``session`` is the
-        affinity key for the ``session`` policy.  Explicit
-        ``request_id``s must be unique among unresolved gateway
-        requests (and msgpack-encodable for remote replicas)."""
+        affinity key for the ``session`` policy; ``tenant``/
+        ``priority`` ride through to the engine's QoS scheduler
+        (inert on envelope-pool replicas).  Explicit ``request_id``s
+        must be unique among unresolved gateway requests (and
+        msgpack-encodable for remote replicas)."""
         self.start()
         spec: dict = {"prompt": np.asarray(prompt, np.int32)}
         if max_new_tokens is not None:
@@ -850,6 +878,10 @@ class ServingGateway:
             spec["meta"] = dict(meta)
         if session is not None:
             spec["session"] = session
+        if tenant is not None:
+            spec["tenant"] = tenant
+        if priority is not None:
+            spec["priority"] = int(priority)
         with self._lock:
             if self._closing:
                 raise RuntimeError("gateway is closed")
@@ -885,7 +917,8 @@ class ServingGateway:
         """Serve an iterable to completion — the gateway-level
         ``DecodeEngine.run``.  Items are prompts or mappings with
         ``"prompt"`` (+ ``max_new_tokens``/``eos_id``/``session``/
-        ``deadline``; other keys ride into results as meta).  Engine
+        ``deadline``/``tenant``/``priority``; other keys ride into
+        results as meta).  Engine
         sheds are absorbed by the failover/backoff machinery, so the
         whole iterable is always accounted for: one result per item.
         """
@@ -908,13 +941,16 @@ class ServingGateway:
         if isinstance(item, Mapping):
             meta = {k: v for k, v in item.items()
                     if k not in ("prompt", "max_new_tokens", "eos_id",
-                                 "session", "deadline")}
+                                 "session", "deadline", "tenant",
+                                 "priority")}
             return self.submit(
                 item["prompt"],
                 max_new_tokens=item.get("max_new_tokens"),
                 eos_id=item.get("eos_id", _UNSET),
                 deadline=item.get("deadline", _UNSET),
-                session=item.get("session"), meta=meta)
+                session=item.get("session"),
+                tenant=item.get("tenant"),
+                priority=item.get("priority"), meta=meta)
         return self.submit(item)
 
     # -- routing ------------------------------------------------------
@@ -931,7 +967,16 @@ class ServingGateway:
             fresh = [r for r in cands if r.name not in req.tried]
             cands = fresh or cands  # all tried: go around again
             if self.policy == "least_loaded":
-                return min(cands, key=lambda r: (r.load(), r.name))
+                # ties on load break on paged headroom (more free KV
+                # pages first, so paged replicas absorb the burst);
+                # envelope replicas report None and sort as 0 —
+                # between queue depth and an exhausted paged pool
+                def _key(r):
+                    fn = getattr(r, "free_pages", None)
+                    fp = fn() if callable(fn) else None
+                    return (r.load(), 0 if fp is None else -fp,
+                            r.name)
+                return min(cands, key=_key)
             if (self.policy == "session"
                     and req.spec.get("session") is not None):
                 cands = sorted(cands, key=lambda r: r.name)

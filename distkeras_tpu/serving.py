@@ -100,7 +100,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu import flight_recorder, paging, telemetry
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
@@ -128,10 +128,11 @@ def _ceil_to(n: int, align: int) -> int:
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "meta",
                  "submit_order", "t_submit", "t_first", "deadline",
-                 "prefix_path", "weights_ver")
+                 "prefix_path", "weights_ver", "tenant", "priority",
+                 "pages", "swap")
 
     def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order,
-                 deadline=None):
+                 deadline=None, tenant=None, priority=1):
         self.rid = rid
         self.prompt = prompt
         self.max_new = max_new
@@ -146,6 +147,10 @@ class _Request:
                          else self.t_submit + deadline)
         self.prefix_path: tuple = ()   # pinned store nodes (admit)
         self.weights_ver = -1          # engine weights at prefill time
+        self.tenant = tenant           # QoS: quota accounting key
+        self.priority = priority       # QoS: 0 (lowest) .. 2 (highest)
+        self.pages: list[int] = []     # paged mode: held page ids
+        self.swap = None               # parked: host KV / restore plan
 
 
 class _PrefixNode:
@@ -264,7 +269,8 @@ class _Pool:
 
     __slots__ = ("env", "n_slots", "dec", "cache", "state", "reqs",
                  "step_fn", "prefill_fn", "queue", "chunk_fn",
-                 "copy_fn", "extract_fn", "prefilling")
+                 "copy_fn", "extract_fn", "prefilling", "cache_tmpl",
+                 "table", "table_np")
 
     def __init__(self, env, n_slots, dec):
         self.env = env
@@ -344,6 +350,40 @@ class DecodeEngine:
         decode, bounding live slots' inter-token latency by the chunk
         quantum instead of the longest neighbor prompt.  Deadlines
         are re-checked between chunks.
+      kv_pages: number of usable device KV pages (``None``: the legacy
+        envelope pools, byte-identical to before).  When set, every
+        bucket's slots draw KV memory from ONE shared block-paged pool
+        (``distkeras_tpu.paging``): a slot costs its actual token
+        count rounded up to a page instead of a whole envelope, so the
+        ``cache_envelope x slots`` memory cliff disappears and the
+        sustainable concurrency at a fixed byte budget is set by the
+        traffic, not the worst case.  Compiled programs gather a
+        slot's pages into the envelope layout, run the UNCHANGED
+        legacy compute, and scatter back — greedy results stay
+        byte-identical to the envelope path.  Every bucket envelope
+        must be a multiple of ``page_size``.
+      page_size: tokens per KV page (default: ``prefill_align``; must
+        equal it while ``prefix_cache_bytes`` is set, so prefix-store
+        segments and pages are the same shape and prefix sharing +
+        paging are one mechanism).
+      preemption: pool-exhaustion policy in paged mode — ``"swap"``
+        (default) parks the lowest-priority live request with its
+        pages swapped to host memory and restores it page-exact when
+        pages free up; ``"recompute"`` parks without saving KV and
+        re-prefills prompt + generated tokens at readmission (cheaper
+        in host memory, re-pays the prefill FLOPs); ``"none"``
+        disables preemption (an exhausted pool sheds the growing
+        request with ``error="kv_pages_exhausted"``).
+      recompute_below: with ``preemption="swap"``, victims whose
+        context (prompt + generated) is at most this many tokens are
+        recompute-parked instead of swapped — below the threshold the
+        re-prefill is cheaper than the host round-trip (0: always
+        swap).
+      tenant_quota: per-tenant page cap enforced at admission (int:
+        every tenant; mapping: listed tenants, others unbounded;
+        ``None``: off).  A quota-blocked request waits in the queue
+        while others admit past it — quotas cannot be fixed by
+        preemption.
     """
 
     def __init__(self, model, variables: Mapping, *, slots: int = 8,
@@ -356,7 +396,12 @@ class DecodeEngine:
                  queue_bound: Optional[int] = None,
                  deadline: Optional[float] = None,
                  prefix_cache_bytes: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_pages: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 preemption: str = "swap",
+                 recompute_below: int = 0,
+                 tenant_quota=None):
         base = _decode_model(model)
         self.max_len = base.max_len
         self.vocab_size = base.vocab_size
@@ -398,6 +443,34 @@ class DecodeEngine:
                 f"prefill_chunk={prefill_chunk} must be a positive "
                 f"multiple of prefill_align={prefill_align} — chunk "
                 "boundaries must land on the padded-shape grid")
+        if kv_pages is not None and kv_pages < 1:
+            raise ValueError(
+                f"kv_pages must be >= 1 (or None); got {kv_pages}")
+        if page_size is None:
+            page_size = prefill_align
+        if page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1; got {page_size}")
+        if (kv_pages is not None and prefix_cache_bytes is not None
+                and page_size != prefill_align):
+            raise ValueError(
+                f"page_size={page_size} must equal prefill_align="
+                f"{prefill_align} while prefix_cache_bytes is set — "
+                "prefix-store segments and KV pages must be the same "
+                "shape for zero-copy interchange")
+        if preemption not in ("swap", "recompute", "none"):
+            raise ValueError(
+                f"preemption must be 'swap', 'recompute', or 'none'; "
+                f"got {preemption!r}")
+        if recompute_below < 0:
+            raise ValueError(
+                f"recompute_below must be >= 0 tokens; got "
+                f"{recompute_below}")
+        if tenant_quota is not None and not isinstance(
+                tenant_quota, Mapping) and int(tenant_quota) < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1 pages (or a mapping, or "
+                f"None); got {tenant_quota}")
         if buckets is None:
             buckets = {self.max_len: slots}
         elif isinstance(buckets, Mapping):
@@ -414,6 +487,12 @@ class DecodeEngine:
             if n < 1:
                 raise ValueError(
                     f"bucket {env} needs >= 1 slots; got {n}")
+            if kv_pages is not None and env % page_size:
+                raise ValueError(
+                    f"bucket envelope {env} is not a multiple of "
+                    f"page_size={page_size} — the paged gather/"
+                    "scatter needs a whole number of pages per "
+                    "envelope")
         self.variables = dict(variables)  # guarded-by: _lock
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
@@ -435,6 +514,18 @@ class DecodeEngine:
         self._prefix = (_PrefixStore(self.prefill_align,
                                      int(prefix_cache_bytes))
                         if prefix_cache_bytes is not None else None)
+        self.kv_pages = kv_pages
+        self.page_size = int(page_size)
+        self.preemption = preemption
+        self.recompute_below = int(recompute_below)
+        self._paged = kv_pages is not None
+        self._alloc = (paging.PageAllocator(kv_pages, self.page_size,
+                                            tenant_quota)
+                       if self._paged else None)
+        self._pages = None       # shared device page pool (paged mode)
+        self._parked = []        # preempted, awaiting readmission
+        self._page_copy_fn = None
+        self._page_extract_fn = None
         self._weights_ver = 0  # guarded-by: _lock
         self._key = jax.random.key(seed)
         self._n_rng = 0
@@ -469,8 +560,22 @@ class DecodeEngine:
             lambda v: pool.dec.apply(v, jnp.zeros((s, 1), jnp.int32),
                                      mutable=["cache"]),
             {"params": self.variables["params"]})[1]["cache"]
-        pool.cache = jax.tree_util.tree_map(
-            lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+        pool.cache_tmpl = shapes
+        if self._paged:
+            # no per-bucket envelope pool: slots read/write the shared
+            # page pool through their table rows (all entries start at
+            # the garbage page)
+            pool.cache = None
+            pool.table_np = np.zeros(
+                (s, pool.env // self.page_size), np.int32)
+            pool.table = jnp.asarray(pool.table_np)
+            if self._pages is None:  # KVH/page/D are bucket-invariant
+                self._pages = paging.build_pool(
+                    shapes, self.kv_pages, self.page_size)
+        else:
+            pool.cache = jax.tree_util.tree_map(
+                lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+            pool.table = pool.table_np = None
         pool.state = {
             "tok": jnp.full((s,), self.pad_id, jnp.int32),
             "pos": jnp.zeros((s,), jnp.int32),
@@ -482,24 +587,26 @@ class DecodeEngine:
         pool.prefill_fn = self._make_prefill(pool)
         pool.chunk_fn = (self._make_chunk_prefill(pool)
                          if self._segmented else None)
-        pool.copy_fn = (self._make_prefix_copy(pool)
-                        if self._prefix is not None else None)
-        pool.extract_fn = (self._make_prefix_extract(pool)
-                           if self._prefix is not None else None)
+        if self._paged:
+            # paged prefix install/donation go page-direct (bucket-
+            # independent shapes: ONE compiled pair for all pools)
+            pool.copy_fn = pool.extract_fn = None
+            if (self._prefix is not None
+                    and self._page_copy_fn is None):
+                self._page_copy_fn = self._make_page_copy()
+                self._page_extract_fn = self._make_page_extract()
+        else:
+            pool.copy_fn = (self._make_prefix_copy(pool)
+                            if self._prefix is not None else None)
+            pool.extract_fn = (self._make_prefix_extract(pool)
+                               if self._prefix is not None else None)
 
     def _make_step(self, pool: _Pool):
         dec, env = pool.dec, pool.env
         temp, top_k, top_p = self.temperature, self.top_k, self.top_p
         pad_id, n_sub = self.pad_id, self.steps_per_sync
 
-        def step_impl(variables, cache, state, rng):
-            # Python side effects: run at TRACE time only, so these
-            # count compilations — the compile-guard test's probe.
-            # The registry counter sees only compiles that happen
-            # while telemetry is enabled (enable before construction).
-            self._traces["step", env] += 1
-            telemetry.metrics().counter(
-                "compiles_total", kind="step", bucket=env).inc()
+        def step_core(variables, cache, state, rng):
             params = {"params": variables["params"]}
 
             def body(carry, sub):
@@ -530,21 +637,42 @@ class DecodeEngine:
             # this predicate.
             return cache, state, toks, was_done
 
-        donate = (1, 2) if self._donate else ()
-        return jax.jit(step_impl, donate_argnums=donate)
+        if not self._paged:
+            def step_impl(variables, cache, state, rng):
+                # Python side effects: run at TRACE time only, so
+                # these count compilations — the compile-guard test's
+                # probe.  The registry counter sees only compiles that
+                # happen while telemetry is enabled (enable before
+                # construction).
+                self._traces["step", env] += 1
+                telemetry.metrics().counter(
+                    "compiles_total", kind="step", bucket=env).inc()
+                return step_core(variables, cache, state, rng)
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(step_impl, donate_argnums=donate)
+
+        tmpl = pool.cache_tmpl
+
+        def paged_step_impl(variables, pages, table, state, rng):
+            self._traces["paged_step", env] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="paged_step", bucket=env).inc()
+            cache = paging.gather_cache(tmpl, pages, table)
+            cache, state, toks, was_done = step_core(
+                variables, cache, state, rng)
+            return (paging.scatter_cache(pages, cache, table), state,
+                    toks, was_done)
+
+        donate = (1, 3) if self._donate else ()
+        return jax.jit(paged_step_impl, donate_argnums=donate)
 
     def _make_prefill(self, pool: _Pool):
         dec, env = pool.dec, pool.env
         temp, top_k, top_p = self.temperature, self.top_k, self.top_p
 
-        def prefill_impl(variables, cache, state, prompt, slot,
+        def prefill_core(variables, cache, state, prompt, slot,
                          last_idx, n_left0, eos_id, rng):
-            # trace-time counter: one compile per (bucket, padded
-            # prompt length) — the bounded prefill program set
-            self._traces["prefill", env, prompt.shape[1]] += 1
-            telemetry.metrics().counter(
-                "compiles_total", kind="prefill", bucket=env,
-                padded=prompt.shape[1]).inc()
             params = {"params": variables["params"]}
             logits, st = dec.apply(params, prompt, mutable=["cache"],
                                    last_index=last_idx)
@@ -571,8 +699,39 @@ class DecodeEngine:
             }
             return cache, state, tok0
 
-        donate = (1, 2) if self._donate else ()
-        return jax.jit(prefill_impl, donate_argnums=donate)
+        if not self._paged:
+            def prefill_impl(variables, cache, state, prompt, slot,
+                             last_idx, n_left0, eos_id, rng):
+                # trace-time counter: one compile per (bucket, padded
+                # prompt length) — the bounded prefill program set
+                self._traces["prefill", env, prompt.shape[1]] += 1
+                telemetry.metrics().counter(
+                    "compiles_total", kind="prefill", bucket=env,
+                    padded=prompt.shape[1]).inc()
+                return prefill_core(variables, cache, state, prompt,
+                                    slot, last_idx, n_left0, eos_id,
+                                    rng)
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(prefill_impl, donate_argnums=donate)
+
+        tmpl = pool.cache_tmpl
+
+        def paged_prefill_impl(variables, pages, table, state, prompt,
+                               slot, last_idx, n_left0, eos_id, rng):
+            self._traces["paged_prefill", env, prompt.shape[1]] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="paged_prefill", bucket=env,
+                padded=prompt.shape[1]).inc()
+            cache = paging.gather_cache(tmpl, pages, table)
+            cache, state, tok0 = prefill_core(
+                variables, cache, state, prompt, slot, last_idx,
+                n_left0, eos_id, rng)
+            return (paging.scatter_cache(pages, cache, table), state,
+                    tok0)
+
+        donate = (1, 3) if self._donate else ()
+        return jax.jit(paged_prefill_impl, donate_argnums=donate)
 
     def _make_chunk_prefill(self, pool: _Pool):
         """One compiled program per (bucket, chunk length) appending a
@@ -593,13 +752,8 @@ class DecodeEngine:
         temp, top_k, top_p = self.temperature, self.top_k, self.top_p
         pad_id = self.pad_id
 
-        def chunk_impl(variables, cache, state, chunk, slot, start,
+        def chunk_core(variables, cache, state, chunk, slot, start,
                        last_rel, is_final, n_left0, eos_id, rng):
-            t_c = chunk.shape[1]
-            self._traces["chunk_prefill", env, t_c] += 1
-            telemetry.metrics().counter(
-                "compiles_total", kind="chunk_prefill", bucket=env,
-                padded=t_c).inc()
             params = {"params": variables["params"]}
 
             def pick(leaf):
@@ -643,8 +797,71 @@ class DecodeEngine:
             }
             return cache, state, tok0
 
-        donate = (1, 2) if self._donate else ()
-        return jax.jit(chunk_impl, donate_argnums=donate)
+        if not self._paged:
+            def chunk_impl(variables, cache, state, chunk, slot,
+                           start, last_rel, is_final, n_left0, eos_id,
+                           rng):
+                t_c = chunk.shape[1]
+                self._traces["chunk_prefill", env, t_c] += 1
+                telemetry.metrics().counter(
+                    "compiles_total", kind="chunk_prefill", bucket=env,
+                    padded=t_c).inc()
+                return chunk_core(variables, cache, state, chunk,
+                                  slot, start, last_rel, is_final,
+                                  n_left0, eos_id, rng)
+
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(chunk_impl, donate_argnums=donate)
+
+        tmpl = pool.cache_tmpl
+
+        def paged_chunk_impl(variables, pages, table, state, chunk,
+                             slot, start, last_rel, is_final, n_left0,
+                             eos_id, rng):
+            t_c = chunk.shape[1]
+            self._traces["paged_chunk_prefill", env, t_c] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="paged_chunk_prefill",
+                bucket=env, padded=t_c).inc()
+            cache = paging.gather_cache(tmpl, pages, table)
+            cache, state, tok0 = chunk_core(
+                variables, cache, state, chunk, slot, start, last_rel,
+                is_final, n_left0, eos_id, rng)
+            return (paging.scatter_cache(pages, cache, table), state,
+                    tok0)
+
+        donate = (1, 3) if self._donate else ()
+        return jax.jit(paged_chunk_impl, donate_argnums=donate)
+
+    def _make_page_copy(self):
+        """Prefix-store install in paged mode: write one cached
+        ``align``-row segment straight into an allocated page — the
+        page IS the slot's block, no envelope in between.  Shapes are
+        bucket-invariant, so this is ONE compiled program for the
+        whole engine."""
+        def page_copy_impl(pages, segments, pid):
+            self._traces["page_copy", self.page_size] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="page_copy",
+                bucket=self.page_size).inc()
+            return [p.at[pid].set(s[0])
+                    for p, s in zip(pages, segments)]
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(page_copy_impl, donate_argnums=donate)
+
+    def _make_page_extract(self):
+        """Prefix donation in paged mode: slice one page out as a
+        ``[1, KVH, page, D]`` store segment (fresh buffers — the pool
+        keeps its own).  One compiled program for the engine."""
+        def page_extract_impl(pages, pid):
+            self._traces["page_extract", self.page_size] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="page_extract",
+                bucket=self.page_size).inc()
+            return [p[pid][None] for p in pages]
+
+        return jax.jit(page_extract_impl)
 
     def _make_prefix_copy(self, pool: _Pool):
         """Device-to-device install of one cached ``align``-row block
@@ -707,7 +924,8 @@ class DecodeEngine:
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                eos_id=_UNSET, request_id=None, deadline=_UNSET,
-               meta: Optional[Mapping] = None):
+               meta: Optional[Mapping] = None, tenant=None,
+               priority: int = 1):
         """Queue one request; returns its id (auto-assigned if None).
 
         ``max_new_tokens``/``eos_id``/``deadline`` default to the
@@ -717,6 +935,13 @@ class DecodeEngine:
         auto-assigned ids skip over in-flight explicit ids.  With
         ``queue_bound`` set, a full admission queue sheds the request
         (``ShedError``) instead of accepting it.
+
+        ``tenant``/``priority`` are the paged-mode QoS keys (accepted
+        but inert on the envelope path): admission picks the highest
+        priority class (2 > 1 > 0, FIFO within a class), per-tenant
+        page quotas are enforced at admission, and on pool exhaustion
+        a higher-priority request preempts the lowest-priority live
+        one instead of waiting behind it.
         """
         if self._closed:
             raise RuntimeError("engine is closed; submit after close()")
@@ -740,7 +965,29 @@ class DecodeEngine:
             raise ValueError(
                 f"deadline must be positive seconds (or None); got "
                 f"{dl}")
+        if not isinstance(priority, int) or not 0 <= priority <= 2:
+            raise ValueError(
+                f"priority must be an int in 0..2; got {priority!r}")
         pool = self._route(len(prompt), max_new)
+        if self._paged:
+            # worst-case page footprint must fit the pool AND the
+            # tenant's whole quota, else the request could park
+            # forever — reject at the door like an unroutable prompt
+            t_p = len(prompt)
+            t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
+            need = max(paging.pages_for(t_pad, self.page_size),
+                       paging.pages_for(min(pool.env, t_p + max_new),
+                                        self.page_size))
+            if need > self.kv_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages at its max length "
+                    f"but the pool has kv_pages={self.kv_pages}")
+            quota = self._alloc.quota_for(tenant)
+            if quota is not None and need > quota:
+                raise ValueError(
+                    f"request needs {need} KV pages at its max length "
+                    f"but tenant {tenant!r} has a tenant_quota of "
+                    f"{quota}")
         m = telemetry.metrics()
         with self._lock:
             if self._closed:
@@ -772,7 +1019,8 @@ class DecodeEngine:
                         "duplicate ids would cross-deliver results")
             req = _Request(rid, prompt, int(max_new), eos,
                            dict(meta or {}), self._n_submitted,
-                           deadline=dl)
+                           deadline=dl, tenant=tenant,
+                           priority=priority)
             self._n_submitted += 1
             self._inflight.add(rid)
             pool.queue.append(req)
@@ -869,6 +1117,8 @@ class DecodeEngine:
                 bucket=pool.env).set(len(pool.queue))
         m.gauge("serving_slot_occupancy", bucket=pool.env).set(
             sum(r is not None for r in pool.reqs))
+        if self._paged:
+            m.gauge("serving_free_pages").set(self._alloc.n_free)
 
     def _shed_expired_queued(self, pool: _Pool) -> list[dict]:
         """Sweep the admission queue for requests already past their
@@ -892,21 +1142,346 @@ class DecodeEngine:
                                           pool.env))
         return out
 
+    # ---- paged-mode QoS: pages, preemption, readmission ---------------
+
+    def _pages_needed(self, t_p: int, pool: _Pool) -> int:
+        """Initial page footprint of a prompt: its padded prefill
+        length (pad rows land in real pages too — they are dead by
+        the write-before-read argument, but keeping them covered
+        means the whole prefill scatter is page-backed)."""
+        t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
+        return paging.pages_for(t_pad, self.page_size)
+
+    def _alloc_pages(self, n: int, tenant) -> Optional[list]:
+        pids = self._alloc.alloc(n, tenant)
+        if pids:
+            telemetry.metrics().counter(
+                "serving_pages_allocated_total").inc(len(pids))
+        return pids
+
+    def _release_pages(self, req: _Request, pool: _Pool = None,
+                       slot: Optional[int] = None) -> None:
+        """Return a request's pages to the allocator and (when it held
+        a slot) point the table row back at the garbage page.  Also
+        drops any parked host KV.  Idempotent — every terminal path
+        funnels through here."""
+        if self._paged and req.pages:
+            self._alloc.free(req.pages, req.tenant)
+            telemetry.metrics().counter(
+                "serving_pages_freed_total").inc(len(req.pages))
+            req.pages = []
+        req.swap = None
+        if pool is not None and slot is not None and self._paged:
+            pool.table_np[slot] = 0
+            pool.table = jnp.asarray(pool.table_np)
+
+    def _set_table_row(self, pool: _Pool, slot: int,
+                       pages: list) -> None:
+        pool.table_np[slot] = 0
+        pool.table_np[slot, :len(pages)] = pages
+        pool.table = jnp.asarray(pool.table_np)
+
+    def _pick_queued(self, pool: _Pool) -> Optional[_Request]:
+        """QoS admission order: highest priority class first, FIFO
+        within a class; quota-blocked requests are skipped (left
+        queued) so they never starve the pool for others."""
+        with self._lock:
+            best = None
+            for req in pool.queue:
+                if not self._alloc.fits_quota(
+                        self._pages_needed(len(req.prompt), pool),
+                        req.tenant):
+                    continue
+                key = (-req.priority, req.submit_order)
+                if best is None or key < best[0]:
+                    best = (key, req)
+            if best is None:
+                return None
+            pool.queue.remove(best[1])
+            return best[1]
+
+    def _pick_victim(self, below: int, exclude=None):
+        """Lowest-priority live decodable request strictly below
+        priority ``below`` (latest-submitted first within a class) —
+        the preemption victim.  Mid-prefill slots are not preempted
+        (their restore plan would be partial)."""
+        best = None
+        for pool in self._pools:
+            for slot, req in enumerate(pool.reqs):
+                if (req is None or slot in pool.prefilling
+                        or req is exclude or req.priority >= below):
+                    continue
+                key = (req.priority, -req.submit_order)
+                if best is None or key < best[0]:
+                    best = (key, pool, slot)
+        return None if best is None else (best[1], best[2])
+
+    def _preempt(self, pool: _Pool, slot: int, reason: str) -> None:
+        """Evict a live request WITHOUT finishing it: swap its pages
+        to host memory (or plan a recompute below the threshold /
+        under ``preemption="recompute"``), free the pages, and park
+        it for readmission.  Restore is page-exact for swap mode, so
+        greedy tokens are unchanged through a preempt cycle."""
+        req = pool.reqs[slot]
+        ctx = len(req.prompt) + len(req.tokens)
+        mode = ("recompute" if self.preemption == "recompute"
+                or ctx <= self.recompute_below else "swap")
+        m = telemetry.metrics()
+        if mode == "swap":
+            with telemetry.span("page_swap", direction="out",
+                                request_id=req.rid,
+                                pages=len(req.pages)):
+                idx = jnp.asarray(np.asarray(req.pages, np.int32))
+                host = jax.device_get(
+                    [leaf[idx] for leaf in self._pages])
+                st = jax.device_get(
+                    {k: v[slot] for k, v in pool.state.items()})
+            req.swap = {"mode": "swap", "pool": pool, "pages": host,
+                        "state": st, "ver": req.weights_ver}
+            m.counter("serving_pages_swapped_total").inc(
+                len(req.pages))
+        else:
+            req.swap = {"mode": "recompute", "pool": pool}
+        pool.reqs[slot] = None
+        # parked requests re-match the store at readmission; holding
+        # pins while parked would block eviction for no reader
+        self._prefix_unpin(req)
+        swap_plan = req.swap  # _release_pages clears it
+        self._release_pages(req, pool, slot)
+        req.swap = swap_plan
+        self._parked.append(req)
+        m.counter("serving_preemptions_total", reason=reason).inc()
+        telemetry.instant("preempt", bucket=pool.env, slot=slot,
+                          request_id=req.rid, mode=mode)
+        flight_recorder.record("preempt", request_id=req.rid,
+                               bucket=pool.env, reason=reason,
+                               mode=mode)
+
+    def _reserve_pages(self, req: _Request, n: int) -> bool:
+        """Allocate ``n`` pages for an arriving/readmitted request,
+        preempting strictly-lower-priority live requests while the
+        pool is short (quota shortfalls never preempt — freeing other
+        tenants' pages cannot help)."""
+        if not self._alloc.fits_quota(n, req.tenant):
+            return False
+        pids = self._alloc_pages(n, req.tenant)
+        while pids is None and self.preemption != "none":
+            victim = self._pick_victim(below=req.priority)
+            if victim is None:
+                return False
+            self._preempt(*victim, reason="admission")
+            pids = self._alloc_pages(n, req.tenant)
+        if pids is None:
+            return False
+        req.pages = pids
+        return True
+
+    def _sweep_parked(self) -> list[dict]:
+        """Deadline check for PARKED requests: a preempted request
+        waiting for readmission expires exactly like a queued one
+        (the pre-paging engine only checked queued and live)."""
+        out = []
+        if not self._parked:
+            return out
+        now = telemetry.now()
+        m = telemetry.metrics()
+        for req in list(self._parked):
+            if req.deadline is not None and now > req.deadline:
+                self._parked.remove(req)
+                env = req.swap["pool"].env
+                self._release_pages(req)
+                m.counter("serving_shed_total", reason="deadline",
+                          bucket=env).inc()
+                out.append(self._finish_error(
+                    req, "deadline_exceeded", env))
+        return out
+
+    def _readmit_parked(self, variables) -> list[dict]:
+        """Readmission sweep: parked requests re-enter (highest
+        priority first, FIFO within a class) when their pool has a
+        free slot and the allocator can cover them.  Swap-mode
+        restores are page-exact; a weight swap since preemption
+        invalidates the saved KV exactly like the prefix store, so
+        those requests recompute from prompt + generated tokens
+        under the new weights instead."""
+        out = []
+        if not self._parked:
+            return out
+        m = telemetry.metrics()
+        for req in sorted(self._parked,
+                          key=lambda r: (-r.priority, r.submit_order)):
+            pool = req.swap["pool"]
+            slot = next(
+                (s for s in range(pool.n_slots)
+                 if pool.reqs[s] is None and s not in pool.prefilling),
+                None)
+            if slot is None:
+                continue
+            # the satellite deadline fix: re-check AT readmission too
+            if (req.deadline is not None
+                    and telemetry.now() > req.deadline):
+                self._parked.remove(req)
+                self._release_pages(req)
+                m.counter("serving_shed_total", reason="deadline",
+                          bucket=pool.env).inc()
+                out.append(self._finish_error(
+                    req, "deadline_exceeded", pool.env))
+                continue
+            mode = req.swap["mode"]
+            if (mode == "swap"
+                    and req.swap["ver"] != self._weights_ver):
+                mode = "recompute"  # stale KV: invalidated like the
+                #                     prefix store on weight swap
+            if mode == "swap":
+                n = len(req.swap["pages"][0])
+            else:
+                ext_len = len(req.prompt) + len(req.tokens)
+                n = self._pages_needed(ext_len, pool)
+            if not self._reserve_pages(req, n):
+                continue  # stays parked; retried next sweep
+            self._parked.remove(req)
+            m.counter("serving_readmissions_total").inc()
+            flight_recorder.record("readmit", request_id=req.rid,
+                                   bucket=pool.env, mode=mode,
+                                   pages=n)
+            if mode == "swap":
+                swap, req.swap = req.swap, None
+                self._set_table_row(pool, slot, req.pages)
+                with telemetry.span("page_swap", direction="in",
+                                    request_id=req.rid, pages=n):
+                    idx = jnp.asarray(
+                        np.asarray(req.pages, np.int32))
+                    self._pages = [
+                        leaf.at[idx].set(jnp.asarray(h))
+                        for leaf, h in zip(self._pages,
+                                           swap["pages"])]
+                    pool.state = {
+                        k: v.at[slot].set(swap["state"][k])
+                        for k, v in pool.state.items()}
+                pool.reqs[slot] = req
+            else:
+                req.swap = None
+                req.weights_ver = self._weights_ver
+                ext = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.tokens, np.int32)])
+                # a request preempted past its envelope was rolling
+                # over row env-1; recompute keeps the most recent
+                # env tokens (the rolled state is unrecoverable by
+                # construction — swap mode preserves it exactly)
+                ext = ext[-pool.env:]
+                out.extend(self._prefill_whole(
+                    pool, slot, req, variables, prompt_override=ext))
+            self._note_gauges(pool)
+        return out
+
+    def _grow_pages(self, pool: _Pool) -> list[dict]:
+        """Before a decode quantum, extend every live slot's table to
+        cover the rows it will write (``pos + steps_per_sync``, capped
+        at the envelope) — an uncovered write would scatter real K/V
+        onto the garbage page and lose it.  Exhaustion preempts a
+        strictly-lower-priority victim; if none exists the grower
+        parks ITSELF (swap/recompute) — or, with preemption off, is
+        shed with ``error="kv_pages_exhausted"``."""
+        out = []
+        page = self.page_size
+        m = telemetry.metrics()
+        for slot in range(pool.n_slots):
+            req = pool.reqs[slot]
+            if req is None or slot in pool.prefilling:
+                continue
+            # host mirror of the device pos: prompt + generated - 1
+            # (the first generated token came from prefill and is
+            # written at pos t_p by the next decode write); live
+            # writes this quantum stop at the remaining budget, so
+            # growth never demands more pages than submit() validated
+            # against kv_pages/quota (dead re-writes past the budget
+            # scatter to the garbage page — dead data, never read)
+            pos = len(req.prompt) + max(0, len(req.tokens) - 1)
+            live = min(self.steps_per_sync,
+                       req.max_new - len(req.tokens))
+            need = paging.pages_for(min(pool.env, pos + live), page)
+            changed = False
+            while len(req.pages) < need:
+                blocked_quota = not self._alloc.fits_quota(
+                    1, req.tenant)
+                pids = (None if blocked_quota
+                        else self._alloc_pages(1, req.tenant))
+                if pids is not None:
+                    req.pages.extend(pids)
+                    changed = True
+                    continue
+                if not blocked_quota and self.preemption != "none":
+                    victim = self._pick_victim(below=req.priority,
+                                               exclude=req)
+                    if victim is not None:
+                        self._preempt(*victim, reason="growth")
+                        continue
+                if self.preemption == "none":
+                    pool.reqs[slot] = None
+                    self._release_pages(req, pool, slot)
+                    m.counter("serving_shed_total",
+                              reason="kv_pages", bucket=pool.env).inc()
+                    out.append(self._finish_error(
+                        req, "kv_pages_exhausted", pool.env))
+                else:
+                    # no lower-priority victim (or quota-blocked):
+                    # park SELF until pages free up
+                    self._preempt(pool, slot,
+                                  reason=("quota" if blocked_quota
+                                          else "growth"))
+                changed = False
+                break
+            if changed:
+                self._set_table_row(pool, slot, req.pages)
+        return out
+
+    def free_pages(self) -> Optional[int]:
+        """Free device KV pages right now (``None``: envelope mode).
+        Safe to read from any thread — the gateway's ``least_loaded``
+        tie-break samples it."""
+        return self._alloc.n_free if self._paged else None
+
+    def paging_stats(self) -> dict:
+        """Host-side paging/QoS counters (operator introspection; the
+        same numbers feed the metrics registry)."""
+        if not self._paged:
+            return {"enabled": False}
+        return {"enabled": True, "parked": len(self._parked),
+                "preemption": self.preemption,
+                **self._alloc.stats()}
+
+    # ---- admission sweep ----------------------------------------------
+
     def _admit(self) -> list[dict]:
         finished = []
         # weights are snapshotted ONCE per admission sweep, so a
         # concurrent swap_variables takes effect at the next step
         # boundary, never mid-sweep
         variables = self.variables
+        if self._paged:
+            finished.extend(self._sweep_parked())
+            finished.extend(self._readmit_parked(variables))
         for pool in self._pools:
             finished.extend(self._shed_expired_queued(pool))
             for slot in range(pool.n_slots):
                 if pool.reqs[slot] is not None:
                     continue
-                with self._lock:  # pop vs racing submit() appends
-                    if not pool.queue:
+                if self._paged:
+                    req = self._pick_queued(pool)
+                    if req is None:
                         break
-                    req = pool.queue.popleft()
+                    if not self._reserve_pages(
+                            req, self._pages_needed(len(req.prompt),
+                                                    pool)):
+                        with self._lock:  # wait at the head, in order
+                            pool.queue.appendleft(req)
+                        break
+                else:
+                    with self._lock:  # pop vs racing submit() appends
+                        if not pool.queue:
+                            break
+                        req = pool.queue.popleft()
                 admit = (self._admit_segmented if self._segmented
                          else self._prefill_whole)
                 finished.extend(admit(pool, slot, req, variables))
@@ -914,26 +1489,43 @@ class DecodeEngine:
         return finished
 
     def _prefill_whole(self, pool: _Pool, slot: int, req: _Request,
-                       variables) -> list[dict]:
+                       variables, prompt_override=None) -> list[dict]:
         """The legacy one-shot prefill: one compiled program writes
         the whole padded prompt into the slot and installs its state
         (byte-identical behavior to the pre-prefix engine — the
-        compile guard pins it)."""
+        compile guard pins it).  ``prompt_override`` is the recompute
+        readmission path: the "prompt" is the original prompt plus
+        every token generated before preemption, and the budget
+        accounting continues from where the request left off."""
         m = telemetry.metrics()
-        t_p = len(req.prompt)
+        prompt = (req.prompt if prompt_override is None
+                  else prompt_override)
+        t_p = len(prompt)
         t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
         padded = np.full((1, t_pad), self.pad_id, np.int32)
-        padded[0, :t_p] = req.prompt
+        padded[0, :t_p] = prompt
+        # generation budget left AFTER this prefill's sampled token
+        n_left0 = req.max_new - len(req.tokens) - 1
         try:
             with telemetry.span("prefill", bucket=pool.env,
                                 slot=slot, padded=t_pad,
                                 request_id=req.rid):
-                pool.cache, pool.state, tok0 = pool.prefill_fn(
-                    variables, pool.cache, pool.state,
-                    jnp.asarray(padded), slot, t_p - 1,
-                    req.max_new - 1,
-                    -1 if req.eos_id is None else req.eos_id,
-                    self._next_rng())
+                if self._paged:
+                    self._set_table_row(pool, slot, req.pages)
+                    (self._pages, pool.state,
+                     tok0) = pool.prefill_fn(
+                        variables, self._pages, pool.table,
+                        pool.state, jnp.asarray(padded), slot,
+                        t_p - 1, n_left0,
+                        -1 if req.eos_id is None else req.eos_id,
+                        self._next_rng())
+                else:
+                    pool.cache, pool.state, tok0 = pool.prefill_fn(
+                        variables, pool.cache, pool.state,
+                        jnp.asarray(padded), slot, t_p - 1,
+                        n_left0,
+                        -1 if req.eos_id is None else req.eos_id,
+                        self._next_rng())
                 req.tokens.append(int(tok0))
         except Exception as e:
             # Per-request error isolation: a poisoned request is
@@ -943,12 +1535,14 @@ class DecodeEngine:
             # donation on, a failure DURING execution can still
             # poison the pool; trace-/dispatch-time failures, the
             # common case, are fully isolated.)
+            self._release_pages(req, pool, slot)
             return [self._finish_error(
                 req, f"prefill_failed: {e!r}", pool.env)]
-        req.t_first = telemetry.now()
+        req.t_first = req.t_first or telemetry.now()
         m.counter("serving_tokens_total", bucket=pool.env).inc()
         pool.reqs[slot] = req
-        if req.max_new == 1 or req.tokens[-1] == req.eos_id:
+        if (len(req.tokens) >= req.max_new
+                or req.tokens[-1] == req.eos_id):
             return [self._finish(pool, slot)]
         return []
 
@@ -976,10 +1570,18 @@ class DecodeEngine:
                                         rows=start,
                                         request_id=req.rid):
                         for b, node in enumerate(path):
-                            pool.cache = pool.copy_fn(
-                                pool.cache, node.segments, slot,
-                                b * align)
+                            if self._paged:
+                                # page == prefix block: install the
+                                # segment into block b's own page
+                                self._pages = self._page_copy_fn(
+                                    self._pages, node.segments,
+                                    req.pages[b])
+                            else:
+                                pool.cache = pool.copy_fn(
+                                    pool.cache, node.segments, slot,
+                                    b * align)
                 except Exception as e:
+                    self._release_pages(req, pool, slot)
                     return [self._finish_error(
                         req, f"prefill_failed: {e!r}", pool.env)]
                 for node in path:   # pin: LRU must not evict under us
@@ -1013,6 +1615,8 @@ class DecodeEngine:
             last_rel = (t_p - 1 - c0) if final else (c1 - c0 - 1)
             chunks.append((c0, padded[:, c0:c1], last_rel, final))
         pool.reqs[slot] = req
+        if self._paged:  # chunk writes must be page-backed from chunk 0
+            self._set_table_row(pool, slot, req.pages)
         pool.prefilling[slot] = {"req": req, "chunks": chunks,
                                  "next": 0}
         if self.prefill_chunk is None:
@@ -1033,6 +1637,7 @@ class DecodeEngine:
         if req.deadline is not None and telemetry.now() > req.deadline:
             pool.reqs[slot] = None
             del pool.prefilling[slot]
+            self._release_pages(req, pool, slot)
             m.counter("serving_shed_total", reason="deadline",
                       bucket=pool.env).inc()
             telemetry.instant("evict", bucket=pool.env, slot=slot,
@@ -1045,18 +1650,27 @@ class DecodeEngine:
                                 slot=slot, start=c0,
                                 size=chunk.shape[1], final=final,
                                 request_id=req.rid):
-                pool.cache, pool.state, tok0 = pool.chunk_fn(
-                    variables, pool.cache, pool.state,
-                    jnp.asarray(chunk), slot, c0, last_rel, final,
-                    req.max_new - 1,
-                    -1 if req.eos_id is None else req.eos_id,
-                    self._next_rng())
+                if self._paged:
+                    self._pages, pool.state, tok0 = pool.chunk_fn(
+                        variables, self._pages, pool.table,
+                        pool.state, jnp.asarray(chunk), slot, c0,
+                        last_rel, final, req.max_new - 1,
+                        -1 if req.eos_id is None else req.eos_id,
+                        self._next_rng())
+                else:
+                    pool.cache, pool.state, tok0 = pool.chunk_fn(
+                        variables, pool.cache, pool.state,
+                        jnp.asarray(chunk), slot, c0, last_rel, final,
+                        req.max_new - 1,
+                        -1 if req.eos_id is None else req.eos_id,
+                        self._next_rng())
                 if final:
                     req.tokens.append(int(tok0))
         except Exception as e:
             # same per-request isolation contract as _prefill_whole
             pool.reqs[slot] = None
             del pool.prefilling[slot]
+            self._release_pages(req, pool, slot)
             return [self._finish_error(
                 req, f"prefill_failed: {e!r}", pool.env)]
         plan["next"] += 1
@@ -1086,6 +1700,11 @@ class DecodeEngine:
         store = self._prefix
         align = self.prefill_align
         n = min(len(req.prompt) // align, pool.env // align)
+        if self._paged:
+            # page_size == prefill_align (enforced in __init__), so
+            # block b of the prompt lives exactly in req.pages[b] —
+            # donation is a page slice, no envelope extraction
+            n = min(n, len(req.pages))
         inserted = False
         try:
             node = store.root
@@ -1093,7 +1712,12 @@ class DecodeEngine:
                 key = req.prompt[b * align:(b + 1) * align].tobytes()
                 child = node.children.get(key)
                 if child is None:
-                    segs = pool.extract_fn(pool.cache, slot, b * align)
+                    if self._paged:
+                        segs = self._page_extract_fn(
+                            self._pages, req.pages[b])
+                    else:
+                        segs = pool.extract_fn(pool.cache, slot,
+                                               b * align)
                     child = store.insert(node, key, segs)
                     inserted = True
                 else:
@@ -1151,6 +1775,10 @@ class DecodeEngine:
             # a swap landed mid-request: its KV is hybrid, never
             # donated.
             self._donate_prefix(pool, slot, req)
+        # pages go back to the free list AFTER donation — the extract
+        # above reads them; freeing never touches device page contents
+        # (page data is only overwritten when a new owner writes it)
+        self._release_pages(req, pool, slot)
         t_finish = telemetry.now()
         ttft = req.t_first - req.t_submit
         latency = t_finish - req.t_submit
@@ -1176,6 +1804,7 @@ class DecodeEngine:
         left its queue/slot."""
         self._inflight.discard(req.rid)
         self._prefix_unpin(req)
+        self._release_pages(req)  # safety net: idempotent, no table
         t_finish = telemetry.now()
         m = telemetry.metrics()
         m.counter("serving_request_errors_total", bucket=env).inc()
@@ -1199,7 +1828,8 @@ class DecodeEngine:
     # ---- serving loop -------------------------------------------------
 
     def has_work(self) -> bool:
-        return any(p.live() or p.queue for p in self._pools)
+        return (any(p.live() or p.queue for p in self._pools)
+                or bool(self._parked))
 
     def step(self) -> list[dict]:
         """Admit waiting requests into free slots, advance every live
@@ -1224,15 +1854,27 @@ class DecodeEngine:
                 slot = next(iter(pool.prefilling))
                 finished.extend(
                     self._advance_prefill(pool, slot, variables))
+            if self._paged:
+                # coverage invariant: before dispatch every live slot's
+                # pages must cover its position plus this quantum's
+                # writes — grow (preempting/parking as needed) NOW
+                finished.extend(self._grow_pages(pool))
             if not pool.decodable():
                 continue
             # the span covers dispatch AND the host sync (np.asarray),
             # so its duration is the true step-quantum latency
             with telemetry.span("decode_step", bucket=pool.env,
                                 steps=self.steps_per_sync):
-                pool.cache, pool.state, toks, was_done = pool.step_fn(
-                    variables, pool.cache, pool.state,
-                    self._next_rng())
+                if self._paged:
+                    (self._pages, pool.state, toks,
+                     was_done) = pool.step_fn(
+                        variables, self._pages, pool.table,
+                        pool.state, self._next_rng())
+                else:
+                    (pool.cache, pool.state, toks,
+                     was_done) = pool.step_fn(
+                        variables, pool.cache, pool.state,
+                        self._next_rng())
                 toks = np.asarray(toks)
                 was_done = np.asarray(was_done)
             n_tok = 0
@@ -1260,6 +1902,7 @@ class DecodeEngine:
                         and now > req.deadline):
                     pool.reqs[slot] = None
                     pool.prefilling.pop(slot, None)
+                    self._release_pages(req, pool, slot)
                     m.counter("serving_shed_total", reason="deadline",
                               bucket=pool.env).inc()
                     telemetry.instant("evict", bucket=pool.env,
@@ -1304,7 +1947,15 @@ class DecodeEngine:
                             req, "engine_closed", pool.env))
                 pool.prefilling.clear()
                 pool.cache = pool.state = None  # release the pool
+                if self._paged:
+                    pool.table = pool.table_np = None
                 self._note_gauges(pool)
+            for req in self._parked:  # preempted requests too
+                env = req.swap["pool"].env if req.swap else 0
+                out.append(self._finish_error(req, "engine_closed",
+                                              env))
+            self._parked.clear()
+            self._pages = None  # release the page pool
             if self._prefix is not None:
                 self._prefix.clear()  # release device segments
             self._closed = True
@@ -1331,11 +1982,13 @@ class DecodeEngine:
         if isinstance(item, Mapping):
             meta = {k: v for k, v in item.items()
                     if k not in ("prompt", "max_new_tokens",
-                                 "eos_id")}
+                                 "eos_id", "tenant", "priority")}
             return self.submit(
                 item["prompt"],
                 max_new_tokens=item.get("max_new_tokens"),
-                eos_id=item.get("eos_id", _UNSET), meta=meta)
+                eos_id=item.get("eos_id", _UNSET),
+                tenant=item.get("tenant"),
+                priority=item.get("priority", 1), meta=meta)
         return self.submit(item)
 
     def run(self, requests: Iterable, *, ordered: bool = True

@@ -1000,6 +1000,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
     "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
     "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
+    "preemption_rate": (0.25, 2.0),       # preemptions per request
 }
 
 #: Signals where LOW is bad: the comparison inverts (breach at/below
@@ -1031,8 +1032,8 @@ class SLOWatchdog:
 
     The signals (PS staleness p99, client retry rate, serving shed
     rate, queue depth, TTFT p95, idle-worker fraction, gateway
-    failover rate, prefix hit rate, PS standby replication lag) are
-    computed from the registry's
+    failover rate, prefix hit rate, PS standby replication lag,
+    KV-page preemption rate) are computed from the registry's
     live metrics and compared against ``(degraded_at, critical_at)``
     thresholds — inverted for ``LOWER_IS_WORSE_SLO_SIGNALS``, where a
     LOW value breaches; the worst breach decides
@@ -1076,7 +1077,7 @@ class SLOWatchdog:
     # -- signal extraction --------------------------------------------
 
     def signals(self) -> dict[str, float]:
-        """The subset of the six signals the registry has samples for."""
+        """The subset of the signals the registry has samples for."""
         r = self.registry
         out: dict[str, float] = {}
         p99 = _merged_percentile(r, "ps_commit_staleness", 0.99)
@@ -1115,6 +1116,13 @@ class SLOWatchdog:
             # inverted signal (see LOWER_IS_WORSE_SLO_SIGNALS) — a
             # LOW rate on a shared-prefix workload is the breach
             out["prefix_hit_rate"] = phits / max(phits + pmiss, 1.0)
+        preempts = r.sum_counter("serving_preemptions_total")
+        if preempts:
+            # KV-page preemptions per submitted request: sustained
+            # thrash means the paged pool is undersized for the
+            # offered load (requests still finish — swap/recompute
+            # readmission hides the churn, at a latency cost)
+            out["preemption_rate"] = preempts / max(reqs, 1.0)
         lag = r.collect("ps_standby_lag")
         if lag:
             # how many commit-log entries the slowest PS standby is

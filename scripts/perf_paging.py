@@ -1,0 +1,321 @@
+"""Block-paged KV vs envelope pools A/B (ISSUE 13): two questions at
+one fixed device-byte budget.
+
+1. **Capacity A/B** — a heavy-tailed workload (mostly short requests,
+   a few near-envelope ones) through two engine arms holding the SAME
+   KV byte budget: ``envelope`` (budget // bytes-per-envelope-slot
+   slots, every request billed for the full envelope) and ``paged``
+   (budget // bytes-per-page pages, requests billed per page actually
+   touched).  Reports the peak number of simultaneously live slots
+   each arm sustains (sampled from the ``serving_slot_occupancy``
+   gauge between steps), goodput, and asserts both arms' greedy
+   tokens are byte-identical — the paged lowering gathers pages into
+   the exact envelope layout and runs the unchanged legacy programs,
+   so parity is structural.
+2. **QoS drill** — a low-priority decode flood saturates each arm,
+   then one high-priority interactive tenant submits.  On the
+   envelope arm the request waits FIFO for a slot to drain; on the
+   paged arm the QoS scheduler admits it next sweep (preempting a
+   low-priority victim's pages if the pool is exhausted).  Reports
+   the interactive TTFT p95 per arm over repeats.
+3. **Gate** — ``serving_pages_allocated_per_sec`` is synthesized from
+   the live registry (``from_registry``) and fed through
+   ``scripts/perf_regress.py`` together with the paged arm's peak
+   concurrency and goodput — against the repo's ``BENCH_*.json``
+   trajectories normally, or a synthetic trajectory from this very
+   run in ``--smoke`` (where the gate must pass and the ISSUE 13
+   acceptance criteria are asserted: strictly more concurrent slots
+   at the fixed budget, byte-identical tokens, and a lower
+   interactive TTFT p95 than the flooded envelope arm).
+
+Usage:  PYTHONPATH=/root/repo python scripts/perf_paging.py
+        [--smoke] [--budget-slots 4] [--page-size 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+if str(REPO / "scripts") not in sys.path:
+    sys.path.insert(0, str(REPO / "scripts"))
+
+import numpy as np
+
+import perf_regress
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import ModelSpec, model_config
+
+    spec = model_config(
+        "transformer_lm", (args.max_len,), input_dtype="int32",
+        vocab_size=args.vocab, num_layers=args.layers,
+        d_model=args.d_model, num_heads=args.heads,
+        max_len=args.max_len, dtype=args.dtype)
+    model = ModelSpec.from_config(spec).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((2, 8), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, args, **kw):
+    from distkeras_tpu.serving import DecodeEngine
+
+    kw.setdefault("buckets", [args.env])
+    kw.setdefault("prefill_align", args.page_size)
+    return DecodeEngine(model, variables, **kw)
+
+
+def kv_slot_bytes(model, variables, args):
+    """Bytes one envelope slot's KV cache occupies, measured off a
+    1-slot probe engine's actual device pool (not estimated)."""
+    import jax
+
+    with _engine(model, variables, args, slots=1) as probe:
+        pool = probe._pools[0]
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(
+            pool.cache) if getattr(x, "ndim", 0) == 4)
+
+
+def build_workload(args):
+    """Heavy-tailed: ``--requests`` prompts, ``--long-frac`` of them
+    near the envelope, the rest short — the traffic shape where
+    per-page billing beats per-envelope billing."""
+    rng = np.random.default_rng(args.seed)
+    n_long = max(1, int(args.requests * args.long_frac))
+    stride = max(1, args.requests // n_long)
+    work = []
+    for i in range(args.requests):
+        if i % stride == 0:
+            t = int(rng.integers(args.env * 5 // 8, args.env * 3 // 4))
+            n_new = args.new_long
+        else:
+            t = int(rng.integers(args.short_lo, args.short_hi + 1))
+            n_new = args.new_short
+        prompt = rng.integers(0, args.vocab, (t,)).astype(np.int32)
+        work.append({"prompt": prompt, "max_new_tokens": n_new,
+                     "i": i})
+    return work
+
+
+def run_capacity_arm(model, variables, work, args, tel, *, paged,
+                     slots, kv_pages=None):
+    """Warm pass (compiles), then the timed pass with the peak
+    slot-occupancy sampled between steps."""
+    kw = {"slots": slots}
+    if paged:
+        kw["kv_pages"] = kv_pages
+    with _engine(model, variables, args, **kw) as eng:
+        list(eng.run(work))  # warm: every program in the set
+        occ = tel.metrics.gauge("serving_slot_occupancy",
+                                bucket=args.env)
+        peak, results = 0, {}
+        t0 = time.perf_counter()
+        for w in work:
+            eng.submit(w["prompt"],
+                       max_new_tokens=w["max_new_tokens"],
+                       meta={"i": w["i"]})
+        while eng.has_work():
+            for r in eng.step():
+                assert r.get("error") is None, r
+                results[r["i"]] = r
+            peak = max(peak, int(occ.value))
+        wall = time.perf_counter() - t0
+    toks = sum(w["max_new_tokens"] for w in work)
+    report = {"paged": paged, "slots": slots, "kv_pages": kv_pages,
+              "peak_concurrent_slots": peak,
+              "wall_s": round(wall, 4),
+              "goodput_tok_s": round(toks / wall, 1)}
+    return report, results
+
+
+def run_qos_arm(model, variables, args, *, paged):
+    """Interactive TTFT under a low-priority flood: best-of-repeats
+    p95 (one warm drill first; the floor is the structural cost)."""
+    rng = np.random.default_rng(args.seed + 1)
+    flood = [rng.integers(0, args.vocab, (args.short_hi,))
+             .astype(np.int32) for _ in range(args.flood)]
+    hi = rng.integers(0, args.vocab, (args.short_lo,)).astype(np.int32)
+    kw = ({"slots": args.flood, "kv_pages": args.kv_pages,
+           "preemption": "swap"} if paged
+          else {"slots": args.budget_slots})
+    ttfts = []
+    with _engine(model, variables, args, **kw) as eng:
+        for rep in range(args.drill_repeats + 1):
+            for j, p in enumerate(flood):
+                eng.submit(p, max_new_tokens=args.new_long,
+                           priority=0, meta={"i": f"lo{rep}.{j}"})
+            list(eng.step())  # flood admitted and decoding
+            eng.submit(hi, max_new_tokens=args.new_short, priority=2,
+                       tenant="interactive", meta={"i": "hi"})
+            got = None
+            while eng.has_work():
+                for r in eng.step():
+                    assert r.get("error") is None, r
+                    if r["i"] == "hi":
+                        got = r
+            if rep > 0:  # warm drill: compile time pollutes TTFT
+                ttfts.append(got["ttft"])
+    return {"paged": paged,
+            "interactive_ttft_p95_s": round(
+                float(np.percentile(ttfts, 95)), 5),
+            "interactive_ttft_best_s": round(min(ttfts), 5)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shapes + the ISSUE 13 acceptance "
+                         "assertions (the tier-1 registration)")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--env", type=int, default=256,
+                    help="bucket envelope (tokens)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--budget-slots", type=int, default=4,
+                    help="KV byte budget, expressed as this many "
+                         "envelope slots; both arms get exactly it")
+    ap.add_argument("--paged-slot-cap", type=int, default=16,
+                    help="table rows on the paged arm (live decode "
+                         "lanes; pages are the real constraint)")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--long-frac", type=float, default=0.125)
+    ap.add_argument("--short-lo", type=int, default=8)
+    ap.add_argument("--short-hi", type=int, default=24)
+    ap.add_argument("--new-short", type=int, default=8)
+    ap.add_argument("--new-long", type=int, default=24)
+    ap.add_argument("--flood", type=int, default=8)
+    ap.add_argument("--drill-repeats", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None)
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="perf_regress gate slack")
+    args = ap.parse_args()
+
+    if args.smoke:
+        # small enough for CPU CI, shaped so the heavy tail leaves
+        # most of the envelope budget idle (the paged arm's win)
+        args.layers, args.d_model, args.heads = 2, 128, 4
+        args.vocab, args.max_len, args.env = 64, 64, 64
+        args.page_size, args.budget_slots = 8, 3
+        args.paged_slot_cap = 12
+        args.requests, args.long_frac = 16, 0.125
+        args.short_lo, args.short_hi = 5, 9
+        args.new_short, args.new_long = 4, 16
+        args.flood, args.drill_repeats = 6, 3
+
+    out_dir = pathlib.Path(args.out_dir
+                           or tempfile.mkdtemp(prefix="dkt_page_"))
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    from distkeras_tpu import flight_recorder, telemetry
+
+    tel = telemetry.enable()
+    flight_recorder.start(out_dir / "fdr")
+    model, variables = _build_model(args)
+    work = build_workload(args)
+
+    env_bytes = kv_slot_bytes(model, variables, args)
+    page_bytes = env_bytes * args.page_size // args.env
+    budget = args.budget_slots * env_bytes
+    args.kv_pages = budget // page_bytes
+    out = {"metric": "paged_kv_qos_ab",
+           "model": f"lm L{args.layers} d{args.d_model}",
+           "env": args.env, "page_size": args.page_size,
+           "budget_bytes": int(budget),
+           "env_slot_bytes": int(env_bytes),
+           "page_bytes": int(page_bytes),
+           "arms": {}}
+
+    t_run0 = time.perf_counter()
+    out["arms"]["envelope"], tok_env = run_capacity_arm(
+        model, variables, work, args, tel, paged=False,
+        slots=args.budget_slots)
+    out["arms"]["paged"], tok_pag = run_capacity_arm(
+        model, variables, work, args, tel, paged=True,
+        slots=args.paged_slot_cap, kv_pages=args.kv_pages)
+    run_seconds = time.perf_counter() - t_run0
+
+    # the lowering must be INVISIBLE: byte-identical greedy tokens
+    for i in sorted(tok_env):
+        np.testing.assert_array_equal(
+            tok_pag[i]["tokens"], tok_env[i]["tokens"],
+            err_msg=f"request {i}")
+    out["parity"] = "byte_identical"
+    out["slot_gain"] = round(
+        out["arms"]["paged"]["peak_concurrent_slots"]
+        / max(out["arms"]["envelope"]["peak_concurrent_slots"], 1), 2)
+
+    out["qos"] = {
+        "envelope": run_qos_arm(model, variables, args, paged=False),
+        "paged": run_qos_arm(model, variables, args, paged=True)}
+
+    snap_path = out_dir / "registry.json"
+    snap_path.write_text(json.dumps(tel.metrics.snapshot(),
+                                    default=repr))
+    flight_recorder.stop()
+    telemetry.disable()
+
+    # ---- the perf_regress hookup: registry counter -> rate candidate
+    cands = perf_regress.from_registry(
+        str(snap_path), "serving_pages_allocated_per_sec",
+        "serving_pages_allocated_total", run_seconds)
+    cands.append({"metric": "paged_concurrent_slots",
+                  "value": out["arms"]["paged"]
+                  ["peak_concurrent_slots"]})
+    cands.append({"metric": "paged_goodput_tok_s",
+                  "value": out["arms"]["paged"]["goodput_tok_s"]})
+    if args.smoke:
+        # synthetic trajectory from this very run — the gate must pass
+        for i, c in enumerate(cands):
+            for n in (1, 2, 3):
+                (out_dir / f"BENCH_c{i}_r{n:02d}.json").write_text(
+                    json.dumps({
+                        "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                        "parsed": {"metric": c["metric"],
+                                   "value": c["value"] * (1 + 0.02 * n),
+                                   "unit": "per_sec"}}))
+        baselines = str(out_dir / "BENCH_*.json")
+    else:
+        baselines = perf_regress.DEFAULT_BASELINES
+    rows = perf_regress.evaluate(
+        cands, perf_regress.load_trajectories(baselines),
+        tolerance=0.5 if args.smoke else args.tolerance)
+    print(perf_regress.render(rows))
+    out["gate"] = [{k: r[k] for k in ("metric", "value", "status")}
+                   for r in rows]
+
+    if args.smoke:
+        # acceptance: strictly more live slots at the SAME byte budget
+        assert (out["arms"]["paged"]["peak_concurrent_slots"]
+                > out["arms"]["envelope"]["peak_concurrent_slots"]), \
+            out["arms"]
+        # the envelope arm is budget-bound at exactly its slot count
+        assert (out["arms"]["envelope"]["peak_concurrent_slots"]
+                == args.budget_slots), out["arms"]
+        # QoS: the interactive tenant's TTFT under flood beats FIFO
+        assert (out["qos"]["paged"]["interactive_ttft_p95_s"]
+                < out["qos"]["envelope"]["interactive_ttft_p95_s"]), \
+            out["qos"]
+        assert all(r["status"] == "pass" for r in rows), rows
+        out["smoke"] = "ok"
+    print(json.dumps(out, default=repr))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ SMOKE_SCRIPTS = {
     "obs_report.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
+    "perf_paging.py": ["--smoke"],
     "perf_prefix.py": ["--smoke"],
     "perf_ps_flagship.py": ["--smoke"],
     "perf_regress.py": ["--smoke"],

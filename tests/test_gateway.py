@@ -595,3 +595,84 @@ def test_failover_rate_signal_reaches_the_watchdog():
         assert w.evaluate()["state"] == "critical"
     finally:
         telemetry.disable()
+
+
+# ---- paged-KV routing + QoS passthrough (ISSUE 13) --------------------
+
+
+class _PagedFakeReplica(_FakeReplica):
+    """Stub replica advertising KV-page headroom and recording the
+    full dispatch spec (not just the id)."""
+
+    def __init__(self, name, load=0, pages=None):
+        super().__init__(name, load=load)
+        self._pages = pages
+        self.specs: list = []
+
+    def free_pages(self):
+        return self._pages
+
+    def dispatch(self, spec, on_result):
+        self.specs.append(dict(spec))
+        super().dispatch(spec, on_result)
+
+
+def test_least_loaded_breaks_ties_on_free_pages():
+    """Equal queue depth: the replica with the most free KV pages
+    wins; envelope replicas (free_pages None) rank below any paged
+    replica with headroom."""
+    reps = [_PagedFakeReplica("a", load=1, pages=2),
+            _PagedFakeReplica("b", load=1, pages=9),
+            _PagedFakeReplica("c", load=1, pages=None)]
+    with ServingGateway(reps, policy="least_loaded") as gw:
+        for r in [gw.submit([1, 2]) for _ in range(5)]:
+            gw.result(r, timeout=5)
+    assert [len(r.dispatched) for r in reps] == [0, 5, 0]
+    # load still dominates the tie-break: an idle envelope replica
+    # beats a busy paged one
+    reps = [_PagedFakeReplica("a", load=3, pages=9),
+            _PagedFakeReplica("b", load=0, pages=None)]
+    with ServingGateway(reps, policy="least_loaded") as gw:
+        for r in [gw.submit([1, 2]) for _ in range(4)]:
+            gw.result(r, timeout=5)
+    assert [len(r.dispatched) for r in reps] == [0, 4]
+
+
+def test_gateway_forwards_tenant_and_priority():
+    rep = _PagedFakeReplica("a")
+    with ServingGateway([rep], policy="round_robin") as gw:
+        rid = gw.submit([1, 2, 3], tenant="acme", priority=2)
+        gw.result(rid, timeout=5)
+        rid2 = gw.submit([1, 2, 3])
+        gw.result(rid2, timeout=5)
+    assert rep.specs[0]["tenant"] == "acme"
+    assert rep.specs[0]["priority"] == 2
+    # absent knobs are NOT forwarded (envelope engines would reject
+    # unknown kwargs from a stale gateway otherwise)
+    assert "tenant" not in rep.specs[1]
+    assert "priority" not in rep.specs[1]
+
+
+def test_engine_replica_reports_free_pages():
+    """A paged in-process replica surfaces allocator headroom through
+    ``free_pages()`` and ``health()``; an envelope replica reports
+    None (routing falls back to queue depth alone)."""
+    model, variables = _model()
+    eng = _engine(model, variables, buckets=[32], kv_pages=8)
+    rep = EngineReplica(eng, name="paged0").start()
+    assert rep.free_pages() == 8
+    assert rep.health()["free_pages"] == 8
+    with ServingGateway([rep], policy="least_loaded") as gw:
+        p = _prompts([6])[0]
+        rid = gw.submit(p, max_new_tokens=4, tenant="t0", priority=2)
+        out = gw.result(rid, timeout=60)
+        np.testing.assert_array_equal(
+            out["tokens"], _want(model, variables, p, 4))
+        assert rep.free_pages() == 8  # all pages returned
+    eng2 = _engine(model, variables, buckets=[32])
+    rep2 = EngineReplica(eng2, name="env0").start()
+    try:
+        assert rep2.free_pages() is None
+        assert rep2.health()["free_pages"] is None
+    finally:
+        rep2.stop()
