@@ -37,6 +37,7 @@ WIRE_SCOPES = {
     "distkeras_tpu/parallel/host_ps.py": "ps",
     "distkeras_tpu/parallel/sharded_ps.py": "ps",
     "distkeras_tpu/parallel/replicated_ps.py": "repl",
+    "distkeras_tpu/parallel/elastic_ps.py": "elastic",
     "distkeras_tpu/gateway.py": "replica",
     "distkeras_tpu/parallel/transport.py": "frame",
 }

@@ -1120,6 +1120,90 @@ class ServingGateway:
                 "total": len(self._replicas),
                 "updating": sorted(updating), "replicas": replicas}
 
+    # -- elastic membership -------------------------------------------
+
+    def add_replica(self, replica, *, source=None,
+                    quiesce_timeout: float = 60.0):
+        """Admit a new replica without disturbing traffic: *register
+        excluded* (routing never sees it yet, ``healthz`` shows it as
+        updating) → *start* → *warm* (weights from ``source``, any
+        form ``rolling_update`` accepts; default: a live peer, so the
+        fleet stays uniform) → *admit*.  On any warm-up failure the
+        replica is deregistered and the error re-raised — the serving
+        set is never left with a cold member.  Returns the replica.
+        """
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("gateway is closed")
+            names = {r.name for r in self._replicas}
+            if replica.name in names:
+                raise ValueError(
+                    f"replica name {replica.name!r} already "
+                    f"registered")
+            started = self._started
+            self._updating.add(replica.name)
+            self._replicas.append(replica)
+        try:
+            if started:
+                replica.start()
+            if source is None:
+                with self._lock:
+                    live = [r for r in self._replicas
+                            if r.alive and r.name != replica.name]
+                if live:
+                    # a replica's variables() IS the full variables
+                    # dict — _resolve_source passes it through
+                    source = jax.device_get(dict(live[0].variables()))
+            if source is not None and replica.alive:
+                replica.swap(self._resolve_source(source))
+        except Exception:
+            with self._lock:
+                self._replicas.remove(replica)
+                self._updating.discard(replica.name)
+            raise
+        with self._lock:
+            self._updating.discard(replica.name)
+            total = len(self._replicas)
+        flight_recorder.record("replica_add", replica=replica.name,
+                               total=total)
+        return replica
+
+    def remove_replica(self, name: str, *,
+                       quiesce_timeout: float = 60.0):
+        """Drain a replica out of the serving set: *exclude from
+        routing* → *quiesce* (its in-flight work completes; new
+        requests already route elsewhere) → *deregister* → *stop* (a
+        local ``EngineReplica``'s engine closes; a remote replica's
+        server is left to its owner, same as ``stop()``).  Refuses to
+        drain the last routable replica.  Returns the removed replica.
+        """
+        with self._lock:
+            by_name = {r.name: r for r in self._replicas}
+            rep = by_name.get(name)
+            if rep is None:
+                raise ValueError(f"no replica named {name!r}: "
+                                 f"{sorted(by_name)}")
+            routable = [r for r in self._replicas
+                        if r.alive and r.name not in self._updating]
+            if [r.name for r in routable] == [name]:
+                raise ValueError(
+                    f"refusing to drain {name!r}: it is the last "
+                    f"routable replica")
+            self._updating.add(name)
+        try:
+            if rep.alive:
+                rep.quiesce(quiesce_timeout)
+        finally:
+            with self._lock:
+                self._replicas.remove(rep)
+                self._updating.discard(name)
+                total = len(self._replicas)
+        if isinstance(rep, EngineReplica):
+            rep.stop()
+        flight_recorder.record("replica_drain", replica=name,
+                               total=total)
+        return rep
+
     # -- rolling weight updates ---------------------------------------
 
     def _resolve_source(self, source) -> dict:

@@ -9,6 +9,13 @@ from distkeras_tpu.parallel.sharded_ps import (  # noqa: F401
     ShardedPSClient,
     plan_shards,
 )
+from distkeras_tpu.parallel.elastic_ps import (  # noqa: F401
+    ElasticPSClient,
+    ElasticPSGroup,
+    ElasticPSServer,
+    MigrationAborted,
+    ShardMap,
+)
 from distkeras_tpu.parallel.moe import (  # noqa: F401
     MoEAux,
     MoEParams,

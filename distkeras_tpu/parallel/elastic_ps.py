@@ -1,0 +1,1437 @@
+"""Elastic sharded parameter server — online shard split / merge /
+migration with zero training downtime (ISSUE 14 tentpole).
+
+``sharded_ps`` freezes the topology at construction: ``plan_shards``
+is a pure function of ``(template, K)``, both endpoints derive it, and
+nothing about the partition ever crosses the wire.  This module makes
+the partition a first-class, *versioned* object instead:
+
+* a ``ShardMap`` names the current topology — an explicit per-shard
+  leaf-index plan (no longer derivable from K), the owning server
+  address per shard, a fencing epoch per shard, and a monotonically
+  increasing version.  Every client op carries ``(version, shard)``;
+  a server that disagrees rejects the op **carrying its own map**, so
+  routing repair costs one round trip, not a config push;
+* each ``ElasticPSNode`` owns a subset of shards and serves the
+  ``"elastic"``-scope wire.  Shard state is the same math as
+  ``sharded_ps.commit_shard`` — same clocks, staleness law, telemetry
+  and reply caching — but the dedupe cache is **per leaf** (global
+  leaf index → ``(seq, reply bytes)``), which is what makes resharding
+  exact: a split partitions the cache by leaf, a merge unions it, and
+  a retried commit whose ack was lost before a reshard still dedupes
+  exactly-once on whatever shard now owns each leaf;
+* migration reuses the replicated-PS recipe (``replicated_ps`` /
+  ``apply_replicated_shard``): the moving shard's owner keeps serving
+  while a ``_Courier`` streams a snapshot plus the tailing commit log
+  — entries carry payload bytes, shipped staleness and reply bytes
+  verbatim, so the receiver's replay reconstructs center, clocks and
+  the dedupe table byte-identically.  At cutover the old owner fences
+  the shard with a ``mint_epoch``-minted epoch (stale writers get
+  ``PSShardFencedError`` and re-route via the map riding the
+  rejection), the residual log drains, and a new map version flips
+  ownership.  If the receiver dies mid-move the courier reports dead,
+  the old owner un-fences, and training continues — a commit is never
+  lost and never applied twice across the move;
+* ``ElasticPSGroup`` is the in-process control plane: it owns the
+  nodes/servers, builds map versions, and drives ``split`` / ``merge``
+  / ``migrate`` / ``add_server`` — the verbs ``telemetry.Autoscaler``
+  calls when ``SLOWatchdog`` signals breach.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.parallel.host_ps import (
+    _NO_SEQ,
+    _PROBE_WORKER,
+    _readonly_view,
+    _to_numpy,
+    PSShardFencedError,
+)
+from distkeras_tpu.parallel.replicated_ps import mint_epoch
+from distkeras_tpu.parallel.sharded_ps import (
+    NEVER_PULLED,
+    leaf_nbytes,
+    pack_leaves,
+    plan_shards,
+    unpack_leaves,
+)
+from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
+
+Pytree = Any
+
+
+class MigrationAborted(RuntimeError):
+    """A shard move could not complete (receiver died / drain timed
+    out); the source shard has been un-fenced and keeps serving."""
+
+
+class ShardMap:
+    """One immutable topology version: who owns which leaves, under
+    which fencing epoch.  Shard ids are scoped to a version — they are
+    renumbered canonically (sorted by first leaf index) every time the
+    plan changes, so a ``(version, shard)`` pair is unambiguous."""
+
+    __slots__ = ("version", "plan", "owners", "epochs")
+
+    def __init__(self, version: int, plan: Sequence[Sequence[int]],
+                 owners: Sequence[tuple[str, int]],
+                 epochs: Sequence[int]):
+        if not (len(plan) == len(owners) == len(epochs)):
+            raise ValueError(
+                f"map arity mismatch: {len(plan)} shards, "
+                f"{len(owners)} owners, {len(epochs)} epochs")
+        self.version = int(version)
+        self.plan = [list(map(int, p)) for p in plan]
+        self.owners = [(str(h), int(p)) for h, p in owners]
+        self.epochs = [int(e) for e in epochs]
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.plan)
+
+    def to_obj(self) -> dict:
+        return {"version": self.version, "plan": self.plan,
+                "owners": [list(o) for o in self.owners],
+                "epochs": self.epochs}
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "ShardMap":
+        return cls(obj["version"], obj["plan"],
+                   [tuple(o) for o in obj["owners"]], obj["epochs"])
+
+    def __repr__(self) -> str:
+        return (f"ShardMap(v{self.version}, "
+                f"{[len(p) for p in self.plan]} leaves/shard, "
+                f"owners={self.owners})")
+
+
+def _canonical(plan: Sequence[Sequence[int]]) -> list[list[int]]:
+    """Shard-id renumbering law: ids sort by first (lowest) leaf index,
+    so client and every server agree on shard order within a map
+    version without shipping the ordering."""
+    return sorted((sorted(int(i) for i in p) for p in plan),
+                  key=lambda p: p[0])
+
+
+class _EShard:
+    """One elastic shard: ``sharded_ps._Shard`` plus a per-leaf dedupe
+    cache, a per-shard fencing epoch and an optional migration courier.
+
+    ``dedupe[worker][global_leaf_idx] = (seq, reply_bytes)`` — per-leaf
+    granularity is the invariant that makes arbitrary resharding
+    exactly-once: whatever shard a leaf lands on after any sequence of
+    splits/merges/moves, its dedupe entry travels with it."""
+
+    __slots__ = ("idx", "lock", "center", "clock", "pull_clock",
+                 "staleness_log", "num_commits", "dedupe",
+                 "reply_bytes", "nbytes", "epoch", "fenced", "retired",
+                 "courier")
+
+    def __init__(self, idx: Sequence[int], center: list[np.ndarray],
+                 epoch: int = 0):
+        self.idx = [int(i) for i in idx]
+        self.lock = racecheck.lock("elastic_ps.shard")
+        self.center = center
+        self.clock = 0
+        self.pull_clock: dict[int, int] = {}
+        self.staleness_log: list[int] = []
+        self.num_commits = 0
+        self.dedupe: dict[int, dict[int, tuple[int, bytes]]] = {}
+        self.reply_bytes = 0
+        self.nbytes = leaf_nbytes(center)
+        self.epoch = int(epoch)
+        self.fenced = False
+        self.retired = False
+        self.courier: Optional["_Courier"] = None
+
+    def key(self) -> tuple[int, ...]:
+        return tuple(self.idx)
+
+
+STALENESS_LOG_WINDOW = 4096
+
+
+def _leaf_bytes(x: np.ndarray) -> bytes:
+    return np.ascontiguousarray(np.asarray(x)).tobytes()
+
+
+def _leaf_from_bytes(data: bytes, template: np.ndarray) -> np.ndarray:
+    t = np.asarray(template)
+    return np.frombuffer(data, dtype=t.dtype).reshape(t.shape)
+
+
+class _Courier:
+    """Migration log shipper: streams one shard's snapshot then tails
+    its commit log to the receiving server over the elastic wire —
+    the replicated-PS ``_Link`` recipe scoped to one shard move.
+
+    ``append`` is called from inside the shard lock (same law as
+    ``ShardedParameterServer.commit_shard``'s replicator ship: the
+    log's order matches the shard-lock order, so replay is
+    byte-identical); the socket send happens on the courier thread,
+    never under the shard lock."""
+
+    #: queue sentinel: pop -> finalize round-trip instead of an append
+    _CONFIRM: dict = {"__confirm__": True}
+
+    def __init__(self, addr: tuple[str, int], bootstrap: dict):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self._bootstrap = bootstrap
+        self._cv = racecheck.condition("elastic_ps.courier")
+        self._queue: list[dict] = []
+        self._inflight = False
+        self._bootstrapped = False
+        self._confirmed = False
+        self._stopping = False
+        self.dead = False
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dkt-shard-courier")
+
+    def start(self) -> "_Courier":
+        self._thread.start()
+        return self
+
+    def append(self, entry: dict) -> None:
+        with self._cv:
+            if self.dead or self._stopping:
+                return
+            self._queue.append(entry)
+            self._cv.notify_all()
+
+    def _mark_dead(self, exc: BaseException) -> None:
+        with self._cv:
+            self.dead = True
+            self.error = exc
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        try:
+            sock = transport.connect(self.addr[0], self.addr[1],
+                                     timeout=10.0)
+        except Exception as e:
+            self._mark_dead(e)
+            return
+        try:
+            transport.send_msg(sock, _PROBE_WORKER.to_bytes(4, "big"))
+            transport.send_msg(
+                sock, b"B" + transport.pack_obj(self._bootstrap))
+            reply = transport.unpack_obj(transport.recv_msg(sock))
+            if not reply.get("ok"):
+                raise ConnectionError(f"bootstrap refused: {reply!r}")
+            with self._cv:
+                self._bootstrapped = True
+                self._cv.notify_all()
+            while True:
+                with self._cv:
+                    while not self._queue and not self._stopping:
+                        self._cv.wait(0.2)
+                    if not self._queue and self._stopping:
+                        return
+                    entry = self._queue.pop(0)
+                    self._inflight = True
+                try:
+                    if entry is self._CONFIRM:
+                        transport.send_msg(sock, b"F")
+                        reply = transport.unpack_obj(
+                            transport.recv_msg(sock))
+                        if not reply.get("ok"):
+                            raise ConnectionError(
+                                f"finalize refused: {reply!r}")
+                        with self._cv:
+                            self._confirmed = True
+                    else:
+                        transport.send_msg(
+                            sock, b"A" + transport.pack_obj(entry))
+                        reply = transport.unpack_obj(
+                            transport.recv_msg(sock))
+                        if not reply.get("ok"):
+                            raise ConnectionError(
+                                f"append refused: {reply!r}")
+                finally:
+                    with self._cv:
+                        self._inflight = False
+                        self._cv.notify_all()
+        except Exception as e:
+            self._mark_dead(e)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def drain(self, timeout: float) -> bool:
+        """Block until every shipped entry is acked (True) or the
+        courier died (False).  Call only after the shard is fenced —
+        a fenced shard appends nothing new, so the queue can only
+        shrink."""
+        deadline = telemetry.now() + float(timeout)
+        with self._cv:
+            while (not self._bootstrapped or self._queue
+                   or self._inflight) and not self.dead:
+                left = deadline - telemetry.now()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.2))
+            return not self.dead
+
+    def confirm(self, timeout: float) -> bool:
+        """Finalize round-trip: prove the receiver is STILL alive and
+        answering after the stream went quiet.  Call after ``drain``
+        — a quiet courier says nothing about the far end (the receiver
+        can die after its last ack), and flipping the map onto a
+        corpse strands every client on a dead owner.  Sends ``F`` and
+        waits for the ack (True) or death/timeout (False)."""
+        with self._cv:
+            if self.dead:
+                return False
+            if not self._confirmed and not any(
+                    e is self._CONFIRM for e in self._queue):
+                self._queue.append(self._CONFIRM)
+                self._cv.notify_all()
+        deadline = telemetry.now() + float(timeout)
+        with self._cv:
+            while not self._confirmed and not self.dead:
+                left = deadline - telemetry.now()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.2))
+            return not self.dead
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+
+
+class ElasticPSNode:
+    """One elastic PS server's state: the shards it owns, the map
+    version it believes, and the adopt-side of migration.
+
+    Lock order is ``node lock -> shard lock`` (the node lock guards
+    routing — the installed map and the shard tables; each shard's
+    data is guarded by its own lock).  The commit path snapshots
+    routing under the node lock, releases it, then takes the shard
+    lock — so a resharder holding the node lock never deadlocks with
+    an in-flight commit, and a commit that loses the race sees the
+    shard's ``retired`` flag and re-routes."""
+
+    def __init__(self, rule: UpdateRule, template: Pytree):
+        self.rule = rule
+        leaves, self._treedef = jax.tree_util.tree_flatten(
+            _to_numpy(template))
+        self._template_leaves = [np.asarray(x) for x in leaves]
+        self._lock = racecheck.lock("elastic_ps.node")
+        self.map: Optional[ShardMap] = None
+        self.address: Optional[tuple[str, int]] = None
+        self._by_leaves: dict[tuple[int, ...], _EShard] = {}
+        self._pending: dict[tuple[int, ...], _EShard] = {}
+        self._route: dict[int, _EShard] = {}
+        self._seen_lock = racecheck.lock("elastic_ps.seen")
+        self._last_seen: dict[int, float] = {}
+
+    # -- liveness (mirrors sharded_ps) ---------------------------------
+
+    def _stamp(self, worker_id: int) -> None:
+        if worker_id == _PROBE_WORKER:
+            return
+        with self._seen_lock:
+            self._last_seen[worker_id] = telemetry.now()
+
+    def retire(self, worker_id: int) -> None:
+        with self._seen_lock:
+            self._last_seen.pop(worker_id, None)
+
+    # -- map install / reshard (the control plane face) ----------------
+
+    def _shard_template(self, idx: Sequence[int]) -> list[np.ndarray]:
+        return [self._template_leaves[i] for i in idx]
+
+    def bootstrap_owned(self, m: ShardMap) -> None:
+        """First install: create fresh shards (template center copies)
+        for every shard this node owns in ``m``."""
+        with self._lock:
+            for sid, idx in enumerate(m.plan):
+                if m.owners[sid] != self.address:
+                    continue
+                key = tuple(idx)
+                if key not in self._by_leaves:
+                    self._by_leaves[key] = _EShard(
+                        idx, [np.array(self._template_leaves[i])
+                              for i in idx], epoch=m.epochs[sid])
+        self.install_map(m)
+
+    def install_map(self, m: ShardMap) -> None:
+        """Adopt a new topology version: owned shards are looked up by
+        leaf tuple among live and migration-adopted (pending) shards;
+        shards this node no longer owns are retired (a late writer
+        holding a stale route gets a stale-map rejection carrying the
+        new map, never a lost update)."""
+        with self._lock:
+            route: dict[int, _EShard] = {}
+            for sid, idx in enumerate(m.plan):
+                if m.owners[sid] != self.address:
+                    continue
+                key = tuple(idx)
+                shard = self._by_leaves.get(key)
+                if shard is None:
+                    shard = self._pending.pop(key, None)
+                    if shard is None:
+                        raise ValueError(
+                            f"map v{m.version} says this node owns "
+                            f"leaves {key} but no such shard exists "
+                            f"(migration bootstrap missing?)")
+                    self._by_leaves[key] = shard
+                route[sid] = shard
+            dropped = [key for key, s in self._by_leaves.items()
+                       if s not in route.values()]
+            for key in dropped:
+                shard = self._by_leaves.pop(key)
+                with shard.lock:
+                    shard.retired = True
+                    if shard.courier is not None:
+                        shard.courier.stop()
+                        shard.courier = None
+            self.map = m
+            self._route = route
+
+    def apply_split(self, key: tuple[int, ...], at: int,
+                    new_map: ShardMap) -> None:
+        """Split the owned shard covering ``key`` at leaf position
+        ``at`` and atomically adopt ``new_map``: children inherit the
+        parent's clock, pull clocks, staleness window and epoch, and
+        the per-leaf dedupe cache partitions between them — under a
+        serial (quiescent-boundary) schedule the children behave
+        byte-identically to a static run that started at this K."""
+        with self._lock:
+            parent = self._by_leaves.pop(key)
+            parent.lock.acquire()   # waits out any in-flight commit
+            try:
+                children = []
+                for part in (parent.idx[:at], parent.idx[at:]):
+                    pos = [parent.idx.index(g) for g in part]
+                    child = _EShard(
+                        part, [np.array(parent.center[p])
+                               for p in pos], epoch=parent.epoch)
+                    child.clock = parent.clock
+                    child.pull_clock = dict(parent.pull_clock)
+                    child.staleness_log = list(parent.staleness_log)
+                    child.num_commits = parent.num_commits
+                    gset = set(part)
+                    for w, entries in parent.dedupe.items():
+                        sub = {g: e for g, e in entries.items()
+                               if g in gset}
+                        if sub:
+                            child.dedupe[w] = sub
+                            child.reply_bytes += sum(
+                                len(b) for _, b in sub.values())
+                    children.append(child)
+                parent.retired = True
+                if parent.courier is not None:
+                    parent.courier.stop()
+                    parent.courier = None
+            finally:
+                parent.lock.release()
+            for child in children:
+                self._by_leaves[child.key()] = child
+        self.install_map(new_map)
+
+    def apply_merge(self, key_a: tuple[int, ...],
+                    key_b: tuple[int, ...],
+                    new_map: ShardMap) -> None:
+        """Merge two owned shards and adopt ``new_map``.  The merged
+        clock is the max of the parents' and pull clocks take the min
+        per worker (staleness stays conservative); at a quiescent
+        commit boundary both parents agree on all of these, so the
+        merge is exact.  Dedupe caches union per leaf."""
+        with self._lock:
+            a = self._by_leaves.pop(key_a)
+            b = self._by_leaves.pop(key_b)
+            a.lock.acquire()
+            # lint: allow(lock-order): two instances of the shard lock
+            # nest only here, under the node lock, and the data plane
+            # holds at most ONE shard lock at a time — no cycle exists
+            b.lock.acquire()
+            try:
+                idx = sorted(a.idx + b.idx)
+                by_g = {g: x for g, x in zip(a.idx, a.center)}
+                by_g.update({g: x for g, x in zip(b.idx, b.center)})
+                merged = _EShard(
+                    idx, [np.array(by_g[g]) for g in idx],
+                    epoch=max(a.epoch, b.epoch))
+                merged.clock = max(a.clock, b.clock)
+                for w in set(a.pull_clock) | set(b.pull_clock):
+                    merged.pull_clock[w] = min(
+                        a.pull_clock.get(w, 0), b.pull_clock.get(w, 0))
+                donor = a if len(a.staleness_log) >= \
+                    len(b.staleness_log) else b
+                merged.staleness_log = list(donor.staleness_log)
+                merged.num_commits = max(a.num_commits, b.num_commits)
+                for parent in (a, b):
+                    for w, entries in parent.dedupe.items():
+                        merged.dedupe.setdefault(w, {}).update(entries)
+                    parent.retired = True
+                    if parent.courier is not None:
+                        parent.courier.stop()
+                        parent.courier = None
+                merged.reply_bytes = sum(
+                    len(bts) for entries in merged.dedupe.values()
+                    for _, bts in entries.values())
+            finally:
+                b.lock.release()
+                a.lock.release()
+            self._by_leaves[merged.key()] = merged
+        self.install_map(new_map)
+
+    # -- migration: source side ----------------------------------------
+
+    def start_courier(self, key: tuple[int, ...],
+                      dst: tuple[str, int]) -> _Courier:
+        with self._lock:
+            shard = self._by_leaves[key]
+        with shard.lock:
+            bootstrap = self._shard_snapshot_locked(shard)
+            courier = _Courier(dst, bootstrap).start()
+            shard.courier = courier
+        return courier
+
+    def _shard_snapshot_locked(self, s: _EShard) -> dict:
+        return {
+            "idx": list(s.idx),
+            "center": pack_leaves(s.center),
+            "clock": int(s.clock),
+            "pull_clock": {str(w): int(c)
+                           for w, c in s.pull_clock.items()},
+            "staleness_log": [int(x) for x in s.staleness_log],
+            "num_commits": int(s.num_commits),
+            "epoch": int(s.epoch),
+            "dedupe": {str(w): {str(g): {"seq": int(seq), "reply": b}
+                                for g, (seq, b) in entries.items()}
+                       for w, entries in s.dedupe.items()},
+        }
+
+    def fence_shard(self, key: tuple[int, ...], epoch: int) -> None:
+        with self._lock:
+            shard = self._by_leaves[key]
+        with shard.lock:
+            shard.fenced = True
+            shard.epoch = max(shard.epoch, int(epoch))
+        telemetry.metrics().counter("ps_fenced_total").inc()
+
+    def unfence_shard(self, key: tuple[int, ...]) -> None:
+        with self._lock:
+            shard = self._by_leaves[key]
+        with shard.lock:
+            shard.fenced = False
+            if shard.courier is not None:
+                shard.courier.stop()
+                shard.courier = None
+
+    # -- migration: receive side ---------------------------------------
+
+    def adopt_bootstrap(self, obj: dict) -> _EShard:
+        idx = [int(i) for i in obj["idx"]]
+        shard = _EShard(
+            idx, [np.array(x) for x in unpack_leaves(
+                self._shard_template(idx), obj["center"])],
+            epoch=int(obj["epoch"]))
+        shard.clock = int(obj["clock"])
+        shard.pull_clock = {int(w): int(c)
+                            for w, c in obj["pull_clock"].items()}
+        shard.staleness_log = [int(x) for x in obj["staleness_log"]]
+        shard.num_commits = int(obj["num_commits"])
+        for w, entries in obj["dedupe"].items():
+            shard.dedupe[int(w)] = {
+                int(g): (int(e["seq"]), bytes(e["reply"]))
+                for g, e in entries.items()}
+        shard.reply_bytes = sum(
+            len(b) for entries in shard.dedupe.values()
+            for _, b in entries.values())
+        with self._lock:
+            self._pending[shard.key()] = shard
+        return shard
+
+    def adopt_entry(self, shard: _EShard, entry: dict) -> None:
+        """Tail-log replay on the receiving node — the elastic twin of
+        ``ShardedParameterServer.apply_replicated_shard``: the shipped
+        staleness is applied and the shipped per-leaf reply bytes are
+        installed verbatim, so center, clocks and dedupe land
+        byte-identical to the source."""
+        applied = [int(g) for g in entry["applied"]]
+        worker = int(entry["worker"])
+        seq = int(entry["seq"])
+        staleness = int(entry["staleness"])
+        with shard.lock:
+            pos = [shard.idx.index(g) for g in applied]
+            temps = [shard.center[p] for p in pos]
+            leaves = unpack_leaves(temps, entry["payload"])
+            state = PSState(
+                center=temps, clock=np.int32(shard.clock))
+            new_state = self.rule.commit(state, leaves,
+                                         np.int32(staleness))
+            for p, x in zip(pos, new_state.center):
+                shard.center[p] = np.asarray(x)
+            shard.clock += 1
+            shard.pull_clock[worker] = shard.clock
+            shard.staleness_log.append(staleness)
+            if len(shard.staleness_log) > \
+                    STALENESS_LOG_WINDOW * 5 // 4:
+                del shard.staleness_log[:-STALENESS_LOG_WINDOW]
+            shard.num_commits += 1
+            if seq != _NO_SEQ:
+                entries = shard.dedupe.setdefault(worker, {})
+                for g, b in entry["dedupe"].items():
+                    old = entries.get(int(g))
+                    if old is not None:
+                        shard.reply_bytes -= len(old[1])
+                    entries[int(g)] = (seq, bytes(b))
+                    shard.reply_bytes += len(b)
+
+    # -- the data plane -------------------------------------------------
+
+    def _routing(self, map_version: int, sid: int
+                 ) -> tuple[Optional[_EShard], ShardMap]:
+        with self._lock:
+            m = self.map
+            if m is None:
+                raise ConnectionError("node has no map installed yet")
+            if int(map_version) != m.version:
+                return None, m
+            return self._route.get(int(sid)), m
+
+    def _current_map(self) -> ShardMap:
+        with self._lock:
+            if self.map is None:
+                raise ConnectionError("node has no map installed yet")
+            return self.map
+
+    def pull_versioned(self, worker_id: int, map_version: int,
+                       since: dict[int, int]) -> dict:
+        """Version-delta pull over the shards this node owns: ships
+        only shards whose clock advanced past ``since[sid]``
+        (``NEVER_PULLED`` forces inclusion); every touched shard
+        stamps the worker's pull clock, shipped or skipped."""
+        m = self._current_map()
+        if int(map_version) != m.version:
+            return {"err": "stale", "map": m.to_obj()}
+        with self._lock:
+            route = dict(self._route)
+        tel = telemetry.metrics()
+        tel.counter("ps_pulls_total").inc()
+        included, skipped, saved = [], 0, 0
+        for sid, shard in sorted(route.items()):
+            last = int(since.get(sid, NEVER_PULLED))
+            with shard.lock:
+                if shard.retired:
+                    return {"err": "stale",
+                            "map": self._current_map().to_obj()}
+                shard.pull_clock[worker_id] = shard.clock
+                if last != NEVER_PULLED and shard.clock <= last:
+                    skipped += 1
+                    saved += shard.nbytes
+                    continue
+                included.append([sid, int(shard.clock),
+                                 pack_leaves(shard.center)])
+        self._stamp(worker_id)
+        if skipped:
+            tel.counter("ps_pull_shards_skipped_total").inc(skipped)
+            tel.counter("ps_pull_bytes_saved_total").inc(saved)
+        return {"ok": True, "inc": included, "skipped": skipped,
+                "saved": saved}
+
+    def commit_shard(self, worker_id: int, map_version: int, sid: int,
+                     payload: bytes, local: Optional[bytes],
+                     seq: Optional[int]) -> dict:
+        """One shard's slice of a logical commit — the same math and
+        telemetry as ``ShardedParameterServer.commit_shard``, with the
+        dedupe check per leaf: leaves whose cached seq already covers
+        this commit are served from cache, fresh leaves are applied
+        (per-leaf rules make the partial apply exact), and the reply
+        is the stitched full-shard pull."""
+        shard, m = self._routing(map_version, sid)
+        if shard is None:
+            return {"err": "stale", "map": m.to_obj()}
+        tel = telemetry.metrics()
+        wait0 = telemetry.now()
+        waiters = tel.gauge("ps_commit_waiters")
+        waiters.inc()
+        shard.lock.acquire()
+        waiters.dec()
+        tel.counter("ps_lock_wait_seconds_total").inc(
+            telemetry.now() - wait0)
+        try:
+            with telemetry.span("ps_shard_commit", worker=worker_id,
+                                shard=sid):
+                if shard.retired:
+                    return {"err": "stale",
+                            "map": self._current_map().to_obj()}
+                if shard.fenced:
+                    return {"err": "fenced", "epoch": shard.epoch,
+                            "map": m.to_obj()}
+                leaves = unpack_leaves(shard.center, payload)
+                local_leaves = (None if local is None else
+                                unpack_leaves(shard.center, local))
+                dmap = shard.dedupe.get(worker_id, {})
+                if seq is None:
+                    fresh = list(range(len(shard.idx)))
+                else:
+                    fresh = [p for p, g in enumerate(shard.idx)
+                             if g not in dmap or dmap[g][0] < seq]
+                if not fresh:
+                    self._stamp(worker_id)
+                    tel.counter("ps_commit_dedup_total").inc()
+                    return {"ok": True, "c": int(shard.clock),
+                            "d": b"".join(dmap[g][1]
+                                          for g in shard.idx)}
+                staleness = shard.clock - shard.pull_clock.get(
+                    worker_id, 0)
+                sub_center = [shard.center[p] for p in fresh]
+                state = PSState(center=sub_center,
+                                clock=np.int32(shard.clock))
+                new_state = self.rule.commit(
+                    state, [leaves[p] for p in fresh],
+                    np.int32(staleness))
+                pulled = self.rule.worker_pull(
+                    None if local_leaves is None
+                    else [local_leaves[p] for p in fresh],
+                    state.center, new_state.center)
+                for p, x in zip(fresh, new_state.center):
+                    shard.center[p] = np.asarray(x)
+                shard.clock += 1
+                shard.pull_clock[worker_id] = shard.clock
+                shard.staleness_log.append(int(staleness))
+                if len(shard.staleness_log) > \
+                        STALENESS_LOG_WINDOW * 5 // 4:
+                    del shard.staleness_log[:-STALENESS_LOG_WINDOW]
+                shard.num_commits += 1
+                tel.counter("ps_shard_commits_total").inc()
+                tel.histogram("ps_commit_staleness",
+                              buckets=telemetry.STALENESS_BUCKETS
+                              ).observe(int(staleness))
+                pulled = [np.asarray(x) for x in pulled]
+                fresh_bytes = {shard.idx[p]: _leaf_bytes(x)
+                               for p, x in zip(fresh, pulled)}
+                if seq is not None:
+                    entries = shard.dedupe.setdefault(worker_id, {})
+                    for g, b in fresh_bytes.items():
+                        old = entries.get(g)
+                        if old is not None:
+                            shard.reply_bytes -= len(old[1])
+                        entries[g] = (int(seq), b)
+                        shard.reply_bytes += len(b)
+                    dmap = entries
+                if shard.courier is not None:
+                    # under THIS shard's lock, before the reply
+                    # escapes: the courier's per-shard log order
+                    # matches the lock order, so the receiver's
+                    # replay is byte-identical (replicated_ps law)
+                    shard.courier.append({
+                        "worker": int(worker_id),
+                        "seq": _NO_SEQ if seq is None else int(seq),
+                        "staleness": int(staleness),
+                        "applied": [shard.idx[p] for p in fresh],
+                        "payload": pack_leaves(
+                            [leaves[p] for p in fresh],
+                            [shard.center[p] for p in fresh]),
+                        "dedupe": ({} if seq is None else
+                                   {str(g): b for g, b
+                                    in fresh_bytes.items()}),
+                    })
+                if sid == m.num_shards - 1:
+                    tel.counter("ps_commits_total").inc()
+                    # one flight event per LOGICAL commit (its last
+                    # shard), mirroring the sharded server
+                    # lint: allow(blocking-call-under-lock): acked =>
+                    # durable — recorded under the last shard's lock
+                    flight_recorder.record(
+                        "commit", worker=worker_id, seq=seq,
+                        clock=int(shard.clock),
+                        shards=m.num_shards,
+                        staleness=int(staleness))
+                self._stamp(worker_id)
+                reply = b"".join(
+                    fresh_bytes[g] if g in fresh_bytes
+                    else dmap[g][1] for g in shard.idx)
+                return {"ok": True, "c": int(shard.clock),
+                        "d": reply}
+        finally:
+            shard.lock.release()
+
+    # -- introspection (control plane / tests) --------------------------
+
+    def owned_leaves(self) -> dict[int, np.ndarray]:
+        with self._lock:
+            shards = list(self._route.values())
+        out: dict[int, np.ndarray] = {}
+        for s in shards:
+            with s.lock:
+                for g, x in zip(s.idx, s.center):
+                    out[g] = _readonly_view(x)
+        return out
+
+    def shard_stats(self) -> dict[int, dict]:
+        with self._lock:
+            route = dict(self._route)
+        out = {}
+        for sid, s in sorted(route.items()):
+            with s.lock:
+                out[sid] = {"clock": int(s.clock),
+                            "num_commits": int(s.num_commits),
+                            "nbytes": int(s.nbytes),
+                            "fenced": bool(s.fenced),
+                            "epoch": int(s.epoch),
+                            "leaves": list(s.idx)}
+        return out
+
+
+class ElasticPSServer:
+    """TCP front end for one ``ElasticPSNode`` — the ``"elastic"``
+    wire scope (handshake: 4-byte worker id, then framed ops).  Body
+    encoding is msgpack (``transport.pack_obj``) with parameter
+    payloads as raw concatenated leaf bytes inside it, so byte
+    identity survives the trip."""
+
+    def __init__(self, node: ElasticPSNode, host: str = "127.0.0.1",
+                 port: int = 0):
+        import socket as _socket
+
+        self.node = node
+        self._sock = _socket.socket()
+        self._sock.setsockopt(_socket.SOL_SOCKET,
+                              _socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = self._sock.getsockname()
+        node.address = self.address
+        self._threads: list[threading.Thread] = []
+        self._conns: list = []
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="dkt-elastic-ps-accept")
+
+    def start(self) -> "ElasticPSServer":
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        import socket as _socket
+
+        try:
+            try:
+                self._sock.settimeout(0.2)
+            except OSError:
+                return
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._sock.accept()
+                except _socket.timeout:
+                    continue
+                except OSError:
+                    break
+                conn.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+                self._conns.append(conn)
+                t = threading.Thread(target=self._serve, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _serve(self, conn):
+        adopted: Optional[_EShard] = None
+        with conn:
+            try:
+                hello = transport.recv_msg(conn)
+                worker_id = int.from_bytes(hello[:4], "big")
+                while True:
+                    msg = transport.recv_msg(conn)
+                    cmd, body = msg[:1], msg[1:]
+                    if cmd == b"m":
+                        transport.send_msg(conn, transport.pack_obj(
+                            self.node._current_map().to_obj()))
+                    elif cmd == b"g":
+                        req = transport.unpack_obj(body)
+                        out = self.node.pull_versioned(
+                            worker_id, req["v"],
+                            {int(s): int(c) for s, c
+                             in req["since"].items()})
+                        transport.send_msg(
+                            conn, transport.pack_obj(out))
+                    elif cmd == b"c":
+                        req = transport.unpack_obj(body)
+                        seq = int(req["q"])
+                        out = self.node.commit_shard(
+                            worker_id, req["v"], req["s"], req["d"],
+                            req.get("l"),
+                            None if seq == _NO_SEQ else seq)
+                        transport.send_msg(
+                            conn, transport.pack_obj(out))
+                    elif cmd == b"B":
+                        adopted = self.node.adopt_bootstrap(
+                            transport.unpack_obj(body))
+                        transport.send_msg(
+                            conn, transport.pack_obj({"ok": True}))
+                    elif cmd == b"A":
+                        if adopted is None:
+                            raise ValueError(
+                                "migrate_append before bootstrap")
+                        self.node.adopt_entry(
+                            adopted, transport.unpack_obj(body))
+                        transport.send_msg(
+                            conn, transport.pack_obj({"ok": True}))
+                    elif cmd == b"F":
+                        # finalize: the courier proves this end is
+                        # still alive before the cutover flips the map
+                        transport.send_msg(conn, transport.pack_obj(
+                            {"ok": adopted is not None}))
+                    elif cmd == b"d":
+                        self.node.retire(worker_id)
+                    elif cmd == b"s":
+                        self._stop.set()
+                        return
+                    else:
+                        raise ValueError(f"unknown command {cmd!r}")
+            except (ConnectionError, OSError):
+                return
+            except Exception as e:
+                import sys
+
+                print(f"[distkeras_tpu] elastic PS handler error "
+                      f"(connection dropped): {e!r}", file=sys.stderr,
+                      flush=True)
+                return
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def kill(self):
+        """Crash simulation: drop the listener and every live
+        connection mid-exchange (the chaos drill kills the RECEIVING
+        server of a migration this way — the courier sees a
+        ``ConnectionError`` and the move aborts cleanly)."""
+        flight_recorder.record(
+            "ps_kill", port=self.address[1],
+            num_commits=sum(
+                s["num_commits"]
+                for s in self.node.shard_stats().values()))
+        flight_recorder.flush(fsync=True)
+        self._stop.set()
+        for s in (self._sock, *self._conns):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def fetch_shard_map(host: str, port: int,
+                    timeout: float = 10.0) -> ShardMap:
+    """One-shot map fetch from any elastic server (the routing-table
+    refresh ``ResilientPSClient`` performs on a shard-fence
+    rejection)."""
+    sock = transport.connect(host, port, timeout=timeout)
+    try:
+        transport.send_msg(sock, _PROBE_WORKER.to_bytes(4, "big"))
+        transport.send_msg(sock, b"m")
+        return ShardMap.from_obj(
+            transport.unpack_obj(transport.recv_msg(sock)))
+    finally:
+        sock.close()
+
+
+class ElasticPSClient:
+    """Worker-side connection(s) speaking the elastic wire.
+
+    Same face as ``PSClient``/``ShardedPSClient`` so
+    ``ResilientPSClient`` wraps it unchanged, plus the elastic verbs:
+    ``refresh_map`` re-pulls the shard map (from current owners first,
+    then the seed addresses) and ``apply_shard_map`` installs a map
+    that rode a fence rejection.  Commits walk the map's shards in id
+    order with ONE logical seq, grouped per owner connection; a
+    ``fenced``/``stale`` reply raises ``PSShardFencedError`` carrying
+    the server's map, which ``ResilientPSClient`` turns into a
+    refresh-and-retry instead of a burned retry attempt."""
+
+    def __init__(self, seeds: Sequence[tuple[str, int]],
+                 worker_id: int, template: Pytree,
+                 stats: Optional[dict] = None):
+        self.worker_id = int(worker_id)
+        leaves, self._treedef = jax.tree_util.tree_flatten(
+            _to_numpy(template))
+        self._template_leaves = [np.asarray(x) for x in leaves]
+        self._seeds = [(str(h), int(p)) for h, p in seeds]
+        self._conns: dict[tuple[str, int], Any] = {}
+        self._stats = stats if stats is not None else {}
+        self._stats.setdefault("pull_shards_skipped", 0)
+        self._stats.setdefault("pull_bytes_saved", 0)
+        self.map: Optional[ShardMap] = None
+        # leaf tuple -> (clock, leaves): survives map changes, so a
+        # reshard only re-pulls shards whose leaf grouping changed
+        self._cache: dict[tuple[int, ...],
+                          tuple[int, list[np.ndarray]]] = {}
+        self.refresh_map()
+
+    # -- connections ----------------------------------------------------
+
+    def _conn(self, addr: tuple[str, int]):
+        sock = self._conns.get(addr)
+        if sock is None:
+            sock = transport.connect(addr[0], addr[1], timeout=30.0)
+            transport.send_msg(
+                sock, int(self.worker_id).to_bytes(4, "big"))
+            self._conns[addr] = sock
+        return sock
+
+    def _drop_conn(self, addr: tuple[str, int]) -> None:
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- the routing table ----------------------------------------------
+
+    def refresh_map(self) -> ShardMap:
+        candidates: list[tuple[str, int]] = []
+        if self.map is not None:
+            candidates.extend(dict.fromkeys(self.map.owners))
+        candidates.extend(a for a in self._seeds
+                          if a not in candidates)
+        last: Optional[Exception] = None
+        for addr in candidates:
+            try:
+                sock = self._conn(addr)
+                transport.send_msg(sock, b"m")
+                obj = transport.unpack_obj(transport.recv_msg(sock))
+            except Exception as e:
+                last = e
+                self._drop_conn(addr)
+                continue
+            self.apply_shard_map(obj)
+            return self.map
+        raise ConnectionError(
+            f"no elastic PS address answered a map fetch "
+            f"(tried {candidates}): {last!r}")
+
+    def apply_shard_map(self, obj: dict | ShardMap) -> None:
+        m = obj if isinstance(obj, ShardMap) else \
+            ShardMap.from_obj(obj)
+        if self.map is not None and m.version < self.map.version:
+            return  # never step routing backwards
+        self.map = m
+        telemetry.metrics().counter("ps_map_refresh_total").inc()
+
+    def _shard_template(self, idx: Sequence[int]) -> list[np.ndarray]:
+        return [self._template_leaves[i] for i in idx]
+
+    def _raise_rejection(self, out: dict, sid: int) -> None:
+        err = out.get("err", "fenced")
+        raise PSShardFencedError(
+            f"shard {sid} rejected the op ({err}): the routing "
+            f"table moved under this client",
+            shard=sid, map_obj=out.get("map"))
+
+    # -- the client face -------------------------------------------------
+
+    def pull(self) -> Pytree:
+        m = self.map
+        by_owner: dict[tuple[str, int], dict[str, int]] = {}
+        for sid, idx in enumerate(m.plan):
+            cached = self._cache.get(tuple(idx))
+            by_owner.setdefault(m.owners[sid], {})[str(sid)] = (
+                NEVER_PULLED if cached is None else cached[0])
+        with telemetry.span("ps_client_pull", worker=self.worker_id):
+            for addr, since in by_owner.items():
+                sock = self._conn(addr)
+                try:
+                    transport.send_msg(
+                        sock, b"g" + transport.pack_obj(
+                            {"v": m.version, "since": since}))
+                    out = transport.unpack_obj(
+                        transport.recv_msg(sock))
+                except Exception:
+                    self._drop_conn(addr)
+                    raise
+                if not out.get("ok"):
+                    self._raise_rejection(out, -1)
+                for sid, clock, data in out["inc"]:
+                    idx = m.plan[int(sid)]
+                    self._cache[tuple(idx)] = (
+                        int(clock),
+                        unpack_leaves(self._shard_template(idx),
+                                      data))
+                self._stats["pull_shards_skipped"] += int(
+                    out.get("skipped", 0))
+                self._stats["pull_bytes_saved"] += int(
+                    out.get("saved", 0))
+        return self._assemble(m)
+
+    def _assemble(self, m: ShardMap) -> Pytree:
+        out: list = [None] * len(self._template_leaves)
+        for idx in m.plan:
+            got = self._cache.get(tuple(idx))
+            if got is None:
+                raise ConnectionError(
+                    f"no cached copy of shard leaves {idx} "
+                    f"(pull before assemble)")
+            for g, x in zip(idx, got[1]):
+                out[g] = x
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def commit(self, payload, local: Pytree | None = None,
+               seq: int | None = None) -> Pytree:
+        m = self.map
+        wire_seq = _NO_SEQ if seq is None else int(seq)
+        leaves = jax.tree_util.tree_leaves(_to_numpy(payload))
+        local_leaves = (None if local is None else
+                        jax.tree_util.tree_leaves(_to_numpy(local)))
+        with telemetry.span("ps_client_commit",
+                            worker=self.worker_id, seq=seq):
+            for sid, idx in enumerate(m.plan):
+                temps = self._shard_template(idx)
+                body = {
+                    "v": m.version, "s": sid, "q": wire_seq,
+                    "d": pack_leaves([leaves[g] for g in idx],
+                                     temps),
+                }
+                if local_leaves is not None:
+                    body["l"] = pack_leaves(
+                        [local_leaves[g] for g in idx], temps)
+                addr = m.owners[sid]
+                sock = self._conn(addr)
+                try:
+                    transport.send_msg(
+                        sock, b"c" + transport.pack_obj(body))
+                    out = transport.unpack_obj(
+                        transport.recv_msg(sock))
+                except Exception:
+                    self._drop_conn(addr)
+                    raise
+                if not out.get("ok"):
+                    self._raise_rejection(out, sid)
+                self._cache[tuple(idx)] = (
+                    int(out["c"]), unpack_leaves(temps, out["d"]))
+        return self._assemble(m)
+
+    def done(self) -> None:
+        for addr in list(self._conns):
+            try:
+                transport.send_msg(self._conns[addr], b"d")
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        for addr in list(self._conns):
+            self._drop_conn(addr)
+
+
+class ElasticPSGroup:
+    """In-process control plane for a fleet of elastic PS servers:
+    owns the nodes, mints map versions, and drives the reshard verbs.
+    The data plane stays on real sockets (workers connect to the
+    member servers), so chaos can kill a member mid-move.
+
+    ``split``/``merge`` re-partition in place on the owning node;
+    ``migrate`` streams a shard to another member with zero downtime
+    (``start_migration`` + ``cutover`` are exposed separately so the
+    chaos drill can kill the receiver in between)."""
+
+    def __init__(self, rule: UpdateRule, center: Pytree,
+                 num_shards: int = 1, num_servers: int = 1, *,
+                 host: str = "127.0.0.1", placement: str = "first",
+                 epoch_group: int = 16):
+        if num_servers < 1:
+            raise ValueError("num_servers must be >= 1")
+        self.rule = rule
+        self._center_template = _to_numpy(center)
+        leaves = jax.tree_util.tree_leaves(self._center_template)
+        self._treedef = jax.tree_util.tree_structure(
+            self._center_template)
+        self._n_leaves = len(leaves)
+        self._epoch_group = int(epoch_group)
+        self._lock = racecheck.lock("elastic_ps.group")
+        self.nodes: list[ElasticPSNode] = []
+        self.servers: list[ElasticPSServer] = []
+        for _ in range(num_servers):
+            node = ElasticPSNode(rule, self._center_template)
+            self.nodes.append(node)
+            self.servers.append(
+                ElasticPSServer(node, host=host).start())
+        plan = _canonical(plan_shards(leaves, num_shards))
+        if placement == "first":
+            owners = [self.servers[0].address] * len(plan)
+        elif placement == "spread":
+            owners = [self.servers[i % num_servers].address
+                      for i in range(len(plan))]
+        else:
+            raise ValueError(f"unknown placement {placement!r}")
+        m = ShardMap(1, plan, owners, [0] * len(plan))
+        for node in self.nodes:
+            node.bootstrap_owned(m)
+        self.map = m
+        self._migrations: dict[int, dict] = {}
+
+    # -- addressing ------------------------------------------------------
+
+    @property
+    def addresses(self) -> list[tuple[str, int]]:
+        return [s.address for s in self.servers]
+
+    @property
+    def num_shards(self) -> int:
+        return self.map.num_shards
+
+    def _node_for(self, addr: tuple[str, int]) -> ElasticPSNode:
+        for node in self.nodes:
+            if node.address == tuple(addr):
+                return node
+        raise KeyError(f"no group member at {addr}")
+
+    def _install_everywhere(self, m: ShardMap,
+                            skip: Sequence[ElasticPSNode] = ()
+                            ) -> None:
+        for node in self.nodes:
+            if node not in skip:
+                node.install_map(m)
+        self.map = m
+
+    def _renumber(self, plan: list[list[int]],
+                  owners: dict[tuple[int, ...], tuple[str, int]],
+                  epochs: dict[tuple[int, ...], int],
+                  version: int) -> ShardMap:
+        new_plan = _canonical(plan)
+        return ShardMap(
+            version, new_plan,
+            [owners[tuple(p)] for p in new_plan],
+            [epochs[tuple(p)] for p in new_plan])
+
+    def _map_pieces(self):
+        m = self.map
+        owners = {tuple(p): m.owners[i] for i, p in enumerate(m.plan)}
+        epochs = {tuple(p): m.epochs[i] for i, p in enumerate(m.plan)}
+        return [list(p) for p in m.plan], owners, epochs
+
+    # -- reshard verbs ---------------------------------------------------
+
+    def split(self, sid: int, at: Optional[int] = None) -> ShardMap:
+        """Split shard ``sid`` at leaf position ``at`` (default: half
+        by leaf count) into two shards on the same owner."""
+        with self._lock:
+            m = self.map
+            idx = m.plan[sid]
+            if len(idx) < 2:
+                raise ValueError(
+                    f"shard {sid} has {len(idx)} leaf; cannot split")
+            at = len(idx) // 2 if at is None else int(at)
+            if not 0 < at < len(idx):
+                raise ValueError(
+                    f"split point {at} outside (0, {len(idx)})")
+            plan, owners, epochs = self._map_pieces()
+            key = tuple(plan.pop(sid))
+            left, right = list(key[:at]), list(key[at:])
+            plan.extend([left, right])
+            owner = owners.pop(key)
+            epoch = epochs.pop(key)
+            for part in (left, right):
+                owners[tuple(part)] = owner
+                epochs[tuple(part)] = epoch
+            new_map = self._renumber(plan, owners, epochs,
+                                     m.version + 1)
+            node = self._node_for(owner)
+            node.apply_split(key, at, new_map)
+            self._install_everywhere(new_map, skip=(node,))
+        telemetry.metrics().counter("elastic_reshards_total",
+                                    kind="split").inc()
+        flight_recorder.record(
+            "shard_split", shard=int(sid), at=int(at),
+            version=new_map.version,
+            sizes=[len(left), len(right)])
+        return new_map
+
+    def merge(self, sid_a: int, sid_b: int) -> ShardMap:
+        """Merge two shards owned by the same server into one."""
+        with self._lock:
+            m = self.map
+            if sid_a == sid_b:
+                raise ValueError("cannot merge a shard with itself")
+            if m.owners[sid_a] != m.owners[sid_b]:
+                raise ValueError(
+                    f"shards {sid_a} and {sid_b} live on different "
+                    f"servers ({m.owners[sid_a]} vs {m.owners[sid_b]}"
+                    f"); migrate one first")
+            plan, owners, epochs = self._map_pieces()
+            key_a, key_b = tuple(m.plan[sid_a]), tuple(m.plan[sid_b])
+            plan = [p for i, p in enumerate(plan)
+                    if i not in (sid_a, sid_b)]
+            merged = sorted(key_a + key_b)
+            plan.append(merged)
+            owner = owners.pop(key_a)
+            owners.pop(key_b)
+            epoch = max(epochs.pop(key_a), epochs.pop(key_b))
+            owners[tuple(merged)] = owner
+            epochs[tuple(merged)] = epoch
+            new_map = self._renumber(plan, owners, epochs,
+                                     m.version + 1)
+            node = self._node_for(owner)
+            node.apply_merge(key_a, key_b, new_map)
+            self._install_everywhere(new_map, skip=(node,))
+        telemetry.metrics().counter("elastic_reshards_total",
+                                    kind="merge").inc()
+        flight_recorder.record(
+            "shard_merge", shards=[int(sid_a), int(sid_b)],
+            version=new_map.version, leaves=len(merged))
+        return new_map
+
+    def add_server(self, host: str = "127.0.0.1") -> int:
+        """Grow the fleet by one (empty) member; returns its index.
+        The new node adopts the current map (owning nothing) so it can
+        serve map fetches and receive migrations immediately."""
+        with self._lock:
+            node = ElasticPSNode(self.rule, self._center_template)
+            server = ElasticPSServer(node, host=host).start()
+            node.install_map(self.map)
+            self.nodes.append(node)
+            self.servers.append(server)
+            return len(self.servers) - 1
+
+    # -- migration -------------------------------------------------------
+
+    def start_migration(self, sid: int, dst: int) -> None:
+        """Begin streaming shard ``sid`` to member ``dst``: snapshot +
+        tail log, while the source keeps serving (zero downtime)."""
+        with self._lock:
+            m = self.map
+            src_addr = m.owners[sid]
+            dst_addr = self.servers[dst].address
+            if src_addr == dst_addr:
+                raise ValueError(
+                    f"shard {sid} already lives on member {dst}")
+            if sid in self._migrations:
+                raise ValueError(f"shard {sid} is already migrating")
+            key = tuple(m.plan[sid])
+            src = self._node_for(src_addr)
+            courier = src.start_courier(key, dst_addr)
+            self._migrations[sid] = {
+                "key": key, "src": src, "dst": dst,
+                "dst_addr": dst_addr, "courier": courier,
+                "t0": telemetry.now(), "version": m.version}
+        flight_recorder.record(
+            "shard_migrate_begin", shard=int(sid),
+            src=list(src_addr), dst=list(dst_addr),
+            version=m.version)
+
+    def cutover(self, sid: int, timeout: float = 30.0) -> ShardMap:
+        """Fence the moving shard, drain the residual log, flip the
+        map.  If the receiver died (or the drain timed out) the source
+        un-fences and keeps the shard — raises ``MigrationAborted``
+        and training continues against the old topology."""
+        with self._lock:
+            mig = self._migrations.pop(sid, None)
+            if mig is None:
+                raise ValueError(f"no migration in flight for shard "
+                                 f"{sid}")
+            m = self.map
+            key, src, courier = mig["key"], mig["src"], mig["courier"]
+            src_idx = self.nodes.index(src)
+            minted = mint_epoch(
+                m.epochs[sid], max(m.epochs), src_idx,
+                max(self._epoch_group, len(self.nodes)))
+            src.fence_shard(key, minted)
+            # drain proves every entry was acked; confirm proves the
+            # receiver is STILL answering — without it a receiver that
+            # dies after its last ack gets the map flipped onto it
+            aborted = not (courier.drain(timeout)
+                           and courier.confirm(timeout))
+            if aborted:
+                src.unfence_shard(key)
+                telemetry.metrics().counter(
+                    "elastic_migrations_aborted_total").inc()
+            else:
+                new_map = self._cutover_locked(mig, key, minted, m)
+                latency = telemetry.now() - mig["t0"]
+        if aborted:
+            flight_recorder.record(
+                "shard_migrate_abort", shard=int(sid),
+                dst=list(mig["dst_addr"]),
+                error=repr(courier.error))
+            raise MigrationAborted(
+                f"receiver {mig['dst_addr']} did not take shard "
+                f"{sid}: {courier.error!r}; source un-fenced, "
+                f"old topology still serving")
+        telemetry.metrics().counter("elastic_reshards_total",
+                                    kind="migrate").inc()
+        telemetry.metrics().histogram(
+            "elastic_migration_seconds").observe(latency)
+        flight_recorder.record(
+            "shard_migrate_cutover", shard=int(sid),
+            dst=list(mig["dst_addr"]), epoch=int(minted),
+            version=new_map.version, latency_s=float(latency))
+        return new_map
+
+    def _cutover_locked(self, mig: dict, key: tuple[int, ...],
+                        minted: int, m: ShardMap) -> ShardMap:
+        mig["courier"].stop()
+        plan, owners, epochs = self._map_pieces()
+        owners[key] = mig["dst_addr"]
+        epochs[key] = minted
+        new_map = self._renumber(plan, owners, epochs,
+                                 m.version + 1)
+        # receiver first (activates its pending shard), source
+        # last (retires its copy only after the new owner routes)
+        self.nodes[mig["dst"]].install_map(new_map)
+        self._install_everywhere(
+            new_map, skip=(self.nodes[mig["dst"]],))
+        return new_map
+
+    def migrate(self, sid: int, dst: int,
+                timeout: float = 30.0) -> ShardMap:
+        self.start_migration(sid, dst)
+        return self.cutover(sid, timeout)
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def center(self) -> Pytree:
+        out: list = [None] * self._n_leaves
+        for node in self.nodes:
+            for g, x in node.owned_leaves().items():
+                out[g] = x
+        missing = [g for g, x in enumerate(out) if x is None]
+        if missing:
+            raise RuntimeError(f"leaves {missing} have no live owner")
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    @property
+    def num_commits(self) -> int:
+        """Logical commits: shard 0 of the current map (every logical
+        commit touches every shard, so any one shard counts them)."""
+        owner = self._node_for(self.map.owners[0])
+        return owner.shard_stats()[0]["num_commits"]
+
+    def shard_stats(self) -> dict:
+        out = {}
+        for node in self.nodes:
+            out.update(node.shard_stats())
+        return out
+
+    def stop(self) -> None:
+        for server in self.servers:
+            server.stop()

@@ -123,6 +123,22 @@ class PSFencedError(ConnectionError):
 
 
 
+class PSShardFencedError(PSFencedError):
+    """One SHARD refused the op — its fencing epoch moved or the
+    client's shard-map version is stale (``elastic_ps``: a split,
+    merge or migration changed the routing table).  Unlike a node-epoch
+    fence, the server is healthy and the fix is routing, not failover:
+    ``ResilientPSClient`` refreshes the shard map (``map_obj`` rides
+    the rejection when the server attached its current map) and
+    retries against the new owner WITHOUT burning a retry attempt."""
+
+    def __init__(self, message: str, *, shard: int | None = None,
+                 map_obj: Any = None):
+        super().__init__(message)
+        self.shard = shard
+        self.map_obj = map_obj
+
+
 class HostParameterServer:
     """Threaded central state: ``pull``/``commit`` under a mutex.
 
@@ -735,8 +751,16 @@ class PSServer:
                 leaves = codec.decode_leaves(body[10:], temps)
             else:
                 leaves = unpack_leaves(temps, body[10:])
+            local = None
+            if self.ps.rule.pull_uses_local:
+                # elastic family: the worker's local slice for THIS
+                # shard rides as a second frame (the b"c" convention,
+                # shard-scoped)
+                raw = transport.recv_msg(conn)
+                rx.inc(len(raw))
+                local = unpack_leaves(temps, raw)
             clock, pulled = self.ps.commit_shard(
-                worker_id, k, leaves, seq=seq)
+                worker_id, k, leaves, local, seq=seq)
             tx.inc(transport.send_msg_gather(
                 conn, clock.to_bytes(8, "big"),
                 *leaf_buffers(pulled, temps)))
@@ -1060,7 +1084,9 @@ class ResilientPSClient:
                  use_seq: bool = True,
                  retry_deadline: float | None = None,
                  on_retry: Optional[Callable[[int, Exception], None]]
-                 = None, worker: int | None = None):
+                 = None, worker: int | None = None,
+                 fence_refresh_limit: int = 2000,
+                 fence_refresh_delay: float = 0.005):
         """``retry_deadline`` (seconds, wall clock) bounds each
         operation's WHOLE retry ladder alongside the attempt-count
         budget: a generous ``retries`` with exponential backoff can
@@ -1086,6 +1112,11 @@ class ResilientPSClient:
         self.jitter = float(jitter)
         self.use_seq = bool(use_seq)
         self.on_retry = on_retry
+        # shard-fence (elastic reshard) routing refreshes: free of the
+        # attempt budget but bounded against livelock — the limit ×
+        # delay product (~10s default) rides out any sane cutover
+        self.fence_refresh_limit = int(fence_refresh_limit)
+        self.fence_refresh_delay = float(fence_refresh_delay)
         self._rng = np.random.default_rng(seed)
         self._raw = None
         self._seq = 0
@@ -1161,6 +1192,24 @@ class ResilientPSClient:
         kwargs.setdefault("worker", worker_id)
         return cls(lambda: _InProcessClient(ps, worker_id), **kwargs)
 
+    @classmethod
+    def for_elastic(cls, seeds, *, worker_id: int, template: Pytree,
+                    stats: dict | None = None, **kwargs
+                    ) -> "ResilientPSClient":
+        """Elastic socket arm (``elastic_ps``): ``seeds`` is any list
+        of group member addresses — the client bootstraps the current
+        versioned shard map from whichever answers and re-routes
+        itself on every fence/stale rejection thereafter.  The one
+        logical seq per commit rides every shard via the per-leaf
+        dedupe table, so retries across a split/merge/migration are
+        exactly-once regardless of where each leaf now lives."""
+        kwargs.setdefault("worker", worker_id)
+        from distkeras_tpu.parallel.elastic_ps import ElasticPSClient
+
+        return cls(lambda: ElasticPSClient(
+            seeds, worker_id=worker_id, template=template,
+            stats=stats), **kwargs)
+
     # -- retry machinery ---------------------------------------------------
 
     def _backoff_delay(self, attempt: int) -> float:
@@ -1190,12 +1239,51 @@ class ResilientPSClient:
         # ps_client_commit/pull span nests under it and inherits its
         # trace id, so a retry storm reads as one causal chain in the
         # merged trace
+        fence_refreshes = 0
         with telemetry.span("ps_op", op=kind, worker=self.worker):
             while True:
                 try:
                     if self._raw is None:
                         self._raw = self._factory()
                     return op(self._raw)
+                except PSShardFencedError as e:
+                    # a shard fence is a ROUTING signal, not a dead
+                    # server: refresh the shard map and go again
+                    # without burning an attempt or the connection —
+                    # the rejection usually carries the new map, so
+                    # the retry lands on the new owner immediately.
+                    # During a cutover's fence window the map has not
+                    # flipped yet; the bounded spin below rides it
+                    # out (the wall-clock deadline still applies).
+                    fence_refreshes += 1
+                    m.counter("ps_shard_fence_refresh_total").inc()
+                    if fence_refreshes > self.fence_refresh_limit:
+                        raise PSRetryExhausted(
+                            f"PS shard stayed fenced/stale through "
+                            f"{fence_refreshes} routing refreshes "
+                            f"(last: {e!r})") from e
+                    if (deadline is not None
+                            and telemetry.now() >= deadline):
+                        raise PSRetryExhausted(
+                            f"PS operation fence-refreshed "
+                            f"{fence_refreshes} time(s); retry "
+                            f"budget retry_deadline="
+                            f"{self.retry_deadline}s (wall clock) "
+                            f"exhausted (last: {e!r})") from e
+                    try:
+                        raw = self._raw
+                        if raw is None:
+                            pass
+                        elif e.map_obj is not None:
+                            raw.apply_shard_map(e.map_obj)
+                        else:
+                            raw.refresh_map()
+                    except Exception:
+                        # the map fetch itself failed — that IS a
+                        # connectivity problem; let the generic
+                        # ladder handle the rebuild
+                        self._close_raw()
+                    time.sleep(self.fence_refresh_delay)
                 except Exception as e:
                     # Exception, not BaseException: KeyboardInterrupt /
                     # MemoryError must not be retried

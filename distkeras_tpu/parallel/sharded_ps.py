@@ -16,12 +16,14 @@ the same way):
 * each shard owns its lock, commit clock, per-worker pull clocks,
   bounded staleness window and commit-seq dedupe cache, so commits
   from different workers convoy only when they touch the same shard at
-  the same instant — semantically safe for the delta family
-  (DOWNPOUR/ADAG/DynSGD apply per-leaf additive updates, and a shard's
-  clock advances exactly like the global clock under any full-tree
-  commit schedule); the elastic family's exchange reads the committing
-  worker's whole local tree against one consistent center, so K > 1 is
-  rejected with a clear error (pin it to K=1);
+  the same instant — semantically exact for BOTH rule families: every
+  rule's ``commit``/``worker_pull`` is per-leaf math (DOWNPOUR/ADAG/
+  DynSGD apply additive deltas; the elastic family lerps each leaf
+  against the center with the same per-shard staleness a K=1 server
+  would compute under a serial schedule, its local tree riding the
+  wire as a second frame per shard — the ``b"c"`` convention,
+  shard-scoped), and a shard's clock advances exactly like the global
+  clock under any full-tree commit schedule;
 * the wire speaks shard-addressed ops over the existing framing:
   commits and replies ride ``transport.send_msg_gather`` (one
   ``sendmsg`` over memoryviews of the already-contiguous leaves — no
@@ -183,13 +185,6 @@ class ShardedParameterServer:
         if int(num_shards) < 1:
             raise ValueError(
                 f"num_shards must be >= 1, got {num_shards}")
-        if rule.payload_kind != "delta" and int(num_shards) > 1:
-            raise ValueError(
-                "the elastic family (payload_kind='params') exchanges "
-                "the worker's whole local tree against one consistent "
-                "center — its commit cannot be split across "
-                "independently-locked shards; use num_shards=1 (or "
-                "HostParameterServer)")
         self.rule = rule
         leaves, self._treedef = jax.tree_util.tree_flatten(
             _to_numpy(center))
@@ -762,10 +757,6 @@ class ShardedPSClient:
 
     def commit(self, payload, local: Pytree | None = None,
                seq: int | None = None) -> Pytree:
-        if local is not None:
-            raise ValueError(
-                "the sharded wire serves the delta family only "
-                "(pull_uses_local rules are pinned to num_shards=1)")
         wire_seq = _NO_SEQ if seq is None else int(seq)
         if seq is not None and not 0 <= wire_seq < _NO_SEQ:
             raise ValueError(f"seq out of range [0, 2**64-1): {seq}")
@@ -786,6 +777,18 @@ class ShardedPSClient:
                 bodies = [self.codec.encode_leaves(s) for s in shards]
             else:
                 bodies = shards
+        local_shards = None
+        if local is not None:
+            # elastic family (pull_uses_local): the local slice for
+            # each shard rides as a second frame after the commit
+            # frame — the shard-scoped twin of the b"c" convention
+            if isinstance(payload, (list, tuple)):
+                raise ValueError(
+                    "pre-encoded shard bytes cannot carry a local "
+                    "tree (the elastic family does not compress)")
+            local_leaves = jax.tree_util.tree_leaves(_to_numpy(local))
+            local_shards = [[local_leaves[i] for i in idx]
+                            for idx in self.plan]
         with telemetry.span("ps_client_commit",
                             worker=self.worker_id, seq=seq):
             for k, body in enumerate(bodies):
@@ -803,6 +806,11 @@ class ShardedPSClient:
                         transport.send_msg_gather(
                             self._sock, hdr + head,
                             *leaf_buffers(body,
+                                          self._shard_templates[k]))
+                    if local_shards is not None:
+                        transport.send_msg_gather(
+                            self._sock,
+                            *leaf_buffers(local_shards[k],
                                           self._shard_templates[k]))
                     if hdr:
                         telemetry.flow_start(

@@ -249,6 +249,16 @@ WIRE_OPS.register("repl", b"b", "bootstrap")
 WIRE_OPS.register("repl", b"k", "ack")
 WIRE_OPS.register("repl", b"f", "fenced")
 WIRE_OPS.register("repl", b"g", "gap")
+# elastic PS protocol (elastic_ps.ElasticPSServer._serve): versioned
+# shard-map routing plus the migration snapshot/tail-log stream
+WIRE_OPS.register("elastic", b"m", "fetch_map")
+WIRE_OPS.register("elastic", b"g", "pull_versioned")
+WIRE_OPS.register("elastic", b"c", "commit_shard")
+WIRE_OPS.register("elastic", b"B", "migrate_bootstrap")
+WIRE_OPS.register("elastic", b"A", "migrate_append")
+WIRE_OPS.register("elastic", b"F", "migrate_finalize")
+WIRE_OPS.register("elastic", b"d", "done")
+WIRE_OPS.register("elastic", b"s", "stop")
 # serving-replica protocol (gateway.ReplicaServer._dispatch)
 WIRE_OPS.register("replica", b"g", "generate")
 WIRE_OPS.register("replica", b"h", "health")

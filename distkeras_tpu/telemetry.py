@@ -997,6 +997,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "queue_depth": (64.0, 256.0),         # queued requests, all buckets
     "ttft_p95_s": (1.0, 10.0),            # seconds to first token
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
+    "ps_lock_wait": (0.005, 0.05),        # lock-wait s / shard commit
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
     "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
     "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
@@ -1042,11 +1043,21 @@ class SLOWatchdog:
     request); ``start()`` adds a background thread that re-evaluates
     every ``interval_s`` and drops an ``slo_state`` instant on the
     trace (plus a flight-recorder event) whenever the state changes.
+
+    ``sustain_secs > 0`` arms hysteresis: a state TRANSITION (in
+    either direction — breach and recovery alike) must hold for that
+    long across consecutive evaluations before it commits; a single
+    noisy sample can no longer flip the state, which is what lets the
+    ``Autoscaler`` act on transitions without flapping.  The default
+    ``sustain_secs=0`` preserves the original edge-trigger exactly.
+    Each verdict carries both the committed ``state`` and the
+    instantaneous ``raw_state``.
     """
 
     def __init__(self, registry,
                  thresholds: Mapping[str, tuple] | None = None,
-                 interval_s: float = 1.0):
+                 interval_s: float = 1.0,
+                 sustain_secs: float = 0.0):
         self.registry = registry
         self.thresholds = dict(DEFAULT_SLO_THRESHOLDS)
         if thresholds:
@@ -1068,6 +1079,14 @@ class SLOWatchdog:
                         f"not exceed critical_at ({c})")
                 self.thresholds[k] = (d, c)
         self.interval_s = float(interval_s)
+        self.sustain_secs = float(sustain_secs)
+        if self.sustain_secs < 0:
+            raise ValueError(
+                f"sustain_secs must be >= 0, got {sustain_secs}")
+        # hysteresis: the candidate state waiting out its sustain
+        # window, and when it first appeared (both under _lock)
+        self._pending_state: str | None = None
+        self._pending_since = 0.0
         self._lock = threading.Lock()
         self._last: dict = {"state": "ok", "signals": {},
                             "breaches": {}}
@@ -1102,6 +1121,15 @@ class SLOWatchdog:
         if registered > 0:
             idle = sum(m.value for _, m in r.collect("ps_idle_workers"))
             out["idle_worker_fraction"] = idle / registered
+        shard_commits = r.sum_counter("ps_shard_commits_total")
+        if shard_commits:
+            # mean seconds a commit spent WAITING for its shard lock:
+            # the PS contention signal — rising wait at flat commit
+            # rate means workers are convoying on too few shards
+            # (the autoscaler's split trigger)
+            out["ps_lock_wait"] = (
+                r.sum_counter("ps_lock_wait_seconds_total")
+                / max(shard_commits, 1.0))
         groutes = r.sum_counter("gateway_requests_total")
         gfails = r.sum_counter("gateway_failovers_total")
         if groutes or gfails:
@@ -1134,10 +1162,13 @@ class SLOWatchdog:
 
     # -- evaluation ---------------------------------------------------
 
-    def evaluate(self) -> dict:
+    def evaluate(self, now_s: float | None = None) -> dict:
+        """One evaluation pass.  ``now_s`` (a ``now()``-clock stamp)
+        is injectable so hysteresis is unit-testable without real
+        sleeps; production callers omit it."""
         sig = self.signals()
         rank = {"ok": 0, "degraded": 1, "critical": 2}
-        state, breaches = "ok", {}
+        raw, breaches = "ok", {}
         for k, v in sig.items():
             degraded_at, critical_at = self.thresholds[k]
             if k in LOWER_IS_WORSE_SLO_SIGNALS:
@@ -1150,12 +1181,31 @@ class SLOWatchdog:
                 breaches[k] = {"value": v, "level": level,
                                "degraded_at": degraded_at,
                                "critical_at": critical_at}
-            if rank[level] > rank[state]:
-                state = level
-        verdict = {"state": state, "signals": sig,
-                   "breaches": breaches}
+            if rank[level] > rank[raw]:
+                raw = level
+        t = now() if now_s is None else float(now_s)
         with self._lock:
             prev = self._last["state"]
+            if raw == prev or not self.sustain_secs:
+                # agreement (or edge-trigger mode): commit instantly
+                # and disarm any pending transition
+                state = raw
+                self._pending_state = None
+            elif self._pending_state != raw:
+                # a NEW candidate state: arm its sustain window (a
+                # candidate that changes — degraded→critical while
+                # waiting — restarts the clock; it is a different
+                # transition)
+                state = prev
+                self._pending_state = raw
+                self._pending_since = t
+            elif t - self._pending_since >= self.sustain_secs:
+                state = raw
+                self._pending_state = None
+            else:
+                state = prev
+            verdict = {"state": state, "raw_state": raw,
+                       "signals": sig, "breaches": breaches}
             self._last = verdict
         if prev != state:
             instant("slo_state", state=state,
@@ -1199,6 +1249,236 @@ class SLOWatchdog:
             self._thread.join()
             self._thread = None
         return self.evaluate()
+
+
+class Autoscaler:
+    """Policy loop that turns ``SLOWatchdog`` verdicts into scaling
+    actions (ISSUE 14): capacity follows load instead of being
+    provisioned for peak.
+
+    Two independent domains, each driven by its own signal set and
+    wired to caller-supplied verbs (pass ``None`` to disable a
+    domain):
+
+    * ``"ps"`` — a breach on any of ``ps_scale_signals``
+      (``ps_lock_wait`` / ``staleness_p99`` by default: workers
+      convoying on too few shards) calls ``split_shard()``; a domain
+      quiet for ``idle_sustain_s`` scales back down via
+      ``merge_shards()``.  ``shard_count()`` reports the current K for
+      the ``min_shards``/``max_shards`` bounds — with an
+      ``elastic_ps.ElasticPSGroup`` these are ``group.split(...)`` /
+      ``group.merge(...)`` wrappers and the reshard happens live under
+      traffic;
+    * ``"gateway"`` — a breach on ``gateway_scale_signals``
+      (``queue_depth`` / ``ttft_p95_s``) calls ``spawn_replica()``
+      (``gateway.add_replica``, which warms weights through
+      ``rolling_update``'s drain-swap-readmit plumbing before
+      admitting); sustained idle calls ``drain_replica()``
+      (``gateway.remove_replica``), bounded by ``min_replicas``/
+      ``max_replicas`` via ``replica_count()``.
+
+    ``cooldown_s`` throttles actions per domain (a split needs time to
+    show up in the signals before the next decision); pair with the
+    watchdog's ``sustain_secs`` hysteresis so one noisy sample cannot
+    trigger a reshard.  EVERY decision — executed, cooldown-suppressed,
+    bounds-suppressed, or failed — lands as an ``autoscale_decision``
+    flight event and in ``autoscale_decisions_total`` so
+    ``postmortem.py`` can replay the scaling story.
+
+    ``decide(verdict, now_s)`` is pure (reads policy state, mutates
+    nothing) — the decision table is unit-testable without servers;
+    ``step()`` executes and advances state; ``start()`` runs ``step``
+    on a daemon thread every ``interval_s``.
+    """
+
+    def __init__(self, watchdog: SLOWatchdog, *,
+                 split_shard=None, merge_shards=None,
+                 spawn_replica=None, drain_replica=None,
+                 shard_count=None, replica_count=None,
+                 min_shards: int = 1, max_shards: int = 8,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 cooldown_s: float = 30.0,
+                 idle_sustain_s: float = 60.0,
+                 interval_s: float = 1.0,
+                 ps_scale_signals=("ps_lock_wait", "staleness_p99"),
+                 gateway_scale_signals=("queue_depth", "ttft_p95_s")):
+        for name, sigs in (("ps_scale_signals", ps_scale_signals),
+                           ("gateway_scale_signals",
+                            gateway_scale_signals)):
+            unknown = set(sigs) - set(DEFAULT_SLO_THRESHOLDS)
+            if unknown:
+                raise ValueError(
+                    f"{name} names unknown SLO signal(s) "
+                    f"{sorted(unknown)}; expected a subset of "
+                    f"{sorted(DEFAULT_SLO_THRESHOLDS)}")
+        if (split_shard is None) != (shard_count is None):
+            raise ValueError(
+                "split_shard and shard_count come as a pair (the "
+                "bounds check needs the live K)")
+        if (spawn_replica is None) != (replica_count is None):
+            raise ValueError(
+                "spawn_replica and replica_count come as a pair (the "
+                "bounds check needs the live replica count)")
+        self.watchdog = watchdog
+        self.split_shard = split_shard
+        self.merge_shards = merge_shards
+        self.spawn_replica = spawn_replica
+        self.drain_replica = drain_replica
+        self.shard_count = shard_count
+        self.replica_count = replica_count
+        self.min_shards = int(min_shards)
+        self.max_shards = int(max_shards)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.cooldown_s = float(cooldown_s)
+        self.idle_sustain_s = float(idle_sustain_s)
+        self.interval_s = float(interval_s)
+        self.ps_scale_signals = tuple(ps_scale_signals)
+        self.gateway_scale_signals = tuple(gateway_scale_signals)
+        # per-domain policy state: last time the domain's signals were
+        # in breach (idle tracking) and last time an action executed
+        # (cooldown).  Seeded "now" lazily on the first step so a
+        # fresh autoscaler neither scales down instantly (idle clock
+        # starts at construction) nor stalls the first scale-up.
+        self._last_breach: dict[str, float] = {}
+        self._last_action: dict[str, float] = {}
+        self._started_at: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- the decision table (pure) ------------------------------------
+
+    def _domain_decision(self, domain: str, breached: dict,
+                         now_s: float, count, lo: int, hi: int,
+                         up: str, down: str,
+                         can_down: bool) -> dict | None:
+        """One domain's verdict row: scale up on breach, down on
+        sustained quiet, else nothing (None)."""
+        last_action = self._last_action.get(domain)
+        cooling = (last_action is not None
+                   and now_s - last_action < self.cooldown_s)
+        n = int(count())
+        if breached:
+            signal, info = next(iter(sorted(breached.items())))
+            d = {"domain": domain, "action": up, "signal": signal,
+                 "value": info["value"], "level": info["level"],
+                 "count": n, "executed": False, "reason": None}
+            if cooling:
+                d["reason"] = "cooldown"
+            elif n >= hi:
+                d["reason"] = "bounds"
+            else:
+                d["executed"] = True
+            return d
+        quiet_since = self._last_breach.get(
+            domain, self._started_at if self._started_at is not None
+            else now_s)
+        if (can_down and n > lo
+                and now_s - quiet_since >= self.idle_sustain_s):
+            d = {"domain": domain, "action": down, "signal": None,
+                 "value": None, "level": "ok", "count": n,
+                 "executed": False, "reason": None}
+            if cooling:
+                d["reason"] = "cooldown"
+            else:
+                d["executed"] = True
+            return d
+        return None
+
+    def decide(self, verdict: dict,
+               now_s: float | None = None) -> list[dict]:
+        """The decisions ``step`` WOULD take on ``verdict`` — pure, so
+        the breach→action / cooldown / bounds table is testable with
+        hand-built verdicts and clocks."""
+        t = now() if now_s is None else float(now_s)
+        breaches = verdict.get("breaches", {})
+        out = []
+        if self.split_shard is not None:
+            d = self._domain_decision(
+                "ps",
+                {k: v for k, v in breaches.items()
+                 if k in self.ps_scale_signals},
+                t, self.shard_count, self.min_shards,
+                self.max_shards, "split", "merge",
+                self.merge_shards is not None)
+            if d is not None:
+                out.append(d)
+        if self.spawn_replica is not None:
+            d = self._domain_decision(
+                "gateway",
+                {k: v for k, v in breaches.items()
+                 if k in self.gateway_scale_signals},
+                t, self.replica_count, self.min_replicas,
+                self.max_replicas, "spawn", "drain",
+                self.drain_replica is not None)
+            if d is not None:
+                out.append(d)
+        return out
+
+    # -- execution ----------------------------------------------------
+
+    _VERBS = {"split": "split_shard", "merge": "merge_shards",
+              "spawn": "spawn_replica", "drain": "drain_replica"}
+
+    def step(self, verdict: dict | None = None,
+             now_s: float | None = None) -> list[dict]:
+        """One policy tick: evaluate (unless a verdict is injected),
+        decide, execute, and record — every decision becomes an
+        ``autoscale_decision`` flight event and an
+        ``autoscale_decisions_total`` count, suppressed ones
+        included."""
+        from distkeras_tpu import flight_recorder
+
+        t = now() if now_s is None else float(now_s)
+        if self._started_at is None:
+            self._started_at = t
+        if verdict is None:
+            verdict = self.watchdog.evaluate(now_s=now_s)
+        decisions = self.decide(verdict, t)
+        breaches = verdict.get("breaches", {})
+        for domain, sigs in (("ps", self.ps_scale_signals),
+                             ("gateway", self.gateway_scale_signals)):
+            if any(k in breaches for k in sigs):
+                self._last_breach[domain] = t
+        m = metrics()
+        for d in decisions:
+            if d["executed"]:
+                try:
+                    getattr(self, self._VERBS[d["action"]])()
+                    self._last_action[d["domain"]] = t
+                except Exception as e:  # the verb failed — record,
+                    d["executed"] = False  # don't kill the loop
+                    d["reason"] = f"error: {e!r}"
+            m.counter("autoscale_decisions_total",
+                      domain=d["domain"], action=d["action"]).inc()
+            flight_recorder.record(
+                "autoscale_decision", domain=d["domain"],
+                action=d["action"], signal=d["signal"],
+                value=d["value"], count=d["count"],
+                executed=d["executed"], reason=d["reason"])
+        return decisions
+
+    # -- background loop ----------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.step()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="dkt-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+            self._thread = None
 
 
 class HistoryView(collections.abc.Mapping):

@@ -794,6 +794,7 @@ class DistributedTrainer(Trainer):
                  ps_address: tuple[str, int] | None = None,
                  ps_replicas: list | None = None,
                  ps_shards: int = 1,
+                 ps_elastic: bool = False,
                  ps_snapshot_path: str | None = None,
                  ps_snapshot_every: int = 0, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
@@ -877,7 +878,23 @@ class DistributedTrainer(Trainer):
         zero-copy scatter-gather wire with version-delta pulls
         (``history['pull_shards_skipped'/'pull_bytes_saved']``).
         With an external ``ps_address`` the server must have been
-        created with the same K.  ``commit_overlap=True`` on the host
+        created with the same K.  Both rule families shard: the delta
+        family's additive updates and the elastic family's per-leaf
+        lerp are each exact per shard (the elastic local tree rides
+        the wire as a second frame per shard).
+
+        ``ps_elastic=True`` (host arm, socket) attaches to an
+        ``parallel.elastic_ps.ElasticPSGroup`` member instead of a
+        classic ``PSServer``: ``ps_address`` seeds the versioned
+        shard-map bootstrap, and the group may split/merge/migrate
+        shards (or be driven by ``telemetry.Autoscaler``) WHILE this
+        trainer runs — workers re-route on fence/stale rejections via
+        ``ResilientPSClient`` with zero training downtime.  Shard
+        topology is owned server-side, so ``ps_shards`` stays 1 here;
+        compression does not compose (the elastic wire ships raw
+        leaf bytes so resharding stays byte-exact).
+
+        ``commit_overlap=True`` on the host
         arm double-buffers each worker's loop: the commit/pull
         exchange for window *n* runs on a background thread while the
         device computes window *n+1* (the worker trains one exchange
@@ -935,6 +952,23 @@ class DistributedTrainer(Trainer):
         if self.ps_shards < 1:
             raise ValueError(
                 f"ps_shards must be >= 1, got {ps_shards}")
+        self.ps_elastic = bool(ps_elastic)
+        if self.ps_elastic:
+            if self.ps_address is None:
+                raise ValueError(
+                    "ps_elastic attaches to an externally managed "
+                    "ElasticPSGroup member; pass ps_address=(host, "
+                    "port) of any group server (it seeds the shard-"
+                    "map bootstrap)")
+            if self.ps_shards > 1:
+                raise ValueError(
+                    "ps_elastic owns its shard topology server-side "
+                    "(the versioned shard map); leave ps_shards=1")
+            if compression is not None:
+                raise ValueError(
+                    "compression does not compose with ps_elastic "
+                    "(the elastic wire ships raw leaf bytes so "
+                    "resharding stays byte-exact)")
         self.ps_snapshot_path = ps_snapshot_path
         self.ps_snapshot_every = int(ps_snapshot_every)
         if not self.tier.concurrent and (self.max_worker_failures
@@ -945,6 +979,7 @@ class DistributedTrainer(Trainer):
                                          or ps_address is not None
                                          or ps_replicas is not None
                                          or self.ps_shards > 1
+                                         or self.ps_elastic
                                          or ps_snapshot_path is not None
                                          or self.ps_snapshot_every):
             raise ValueError(
@@ -1576,13 +1611,6 @@ class DistributedTrainer(Trainer):
                 "(DOWNPOUR/ADAG/DynSGD): their additive payloads are "
                 "error-feedback-correctable; the elastic family "
                 "commits absolute parameters")
-        if self.ps_shards > 1 and rule.payload_kind != "delta":
-            raise ValueError(
-                "ps_shards > 1 applies to the delta-family rules "
-                "(per-leaf additive updates shard safely); the "
-                "elastic exchange reads the worker's whole local tree "
-                "against one consistent center — pin it to "
-                "ps_shards=1")
         if self.commit_overlap and rule.payload_kind != "delta":
             raise ValueError(
                 "commit_overlap on the host arm supports the delta "
@@ -1850,14 +1878,19 @@ class DistributedTrainer(Trainer):
                             on_retry=on_retry)
             socket_arm = (ps_address is not None
                           or self.ps_replicas is not None)
-            sharded_socket = socket_arm and self.ps_shards > 1
+            sharded_socket = socket_arm and (self.ps_shards > 1
+                                             or self.ps_elastic)
             # per-worker, so client instances (rebuilt per reconnect)
             # accumulate race-free; folded into the shared counters
             # in the finally below
             shard_stats = ({"pull_shards_skipped": 0,
                             "pull_bytes_saved": 0}
                            if sharded_socket else None)
-            if self.ps_replicas is not None:
+            if self.ps_elastic:
+                client = ResilientPSClient.for_elastic(
+                    [ps_address], worker_id=w, template=center,
+                    stats=shard_stats, **retry_kw)
+            elif self.ps_replicas is not None:
                 client = ResilientPSClient.for_replicas(
                     self.ps_replicas, worker_id=w, template=center,
                     codec=codec, shards=self.ps_shards,
@@ -2163,7 +2196,8 @@ class DistributedTrainer(Trainer):
         if codec is not None:
             self._record(commit_wire_bytes=int(wire_total.value),
                          commit_raw_bytes=int(raw_total.value))
-        if self.ps_shards > 1 and self.transport == "socket":
+        if ((self.ps_shards > 1 or self.ps_elastic)
+                and self.transport == "socket"):
             # version-delta pull savings (process-local): shards the
             # server did NOT ship because this process's workers were
             # already current on them
@@ -2239,6 +2273,19 @@ class DistributedTrainer(Trainer):
                 self._record(
                     ps_failovers=int(failover_total.value),
                     ps_epoch=served_epoch)
+            finally:
+                fin.close()
+        elif self.ps_elastic:
+            # elastic external PS: the group may have split / merged /
+            # migrated mid-run, so the final pull walks the versioned
+            # shard map exactly the way the workers did
+            fin = ResilientPSClient.for_elastic(
+                [self.ps_address], worker_id=num_workers,
+                template=center, retries=self.worker_retries,
+                seed=self.seed)
+            try:
+                final_center = fin.pull()
+                fin.done()
             finally:
                 fin.close()
         else:

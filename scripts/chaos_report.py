@@ -1,7 +1,7 @@
 """Chaos / recovery report — exercise the fault-tolerance layer end to
 end and summarize the recovery evidence from the telemetry registry.
 
-Three scenarios (all run by ``--smoke``, the tier-1 registration via
+Four scenarios (all run by ``--smoke``, the tier-1 registration via
 test_examples.py's scripts-coverage check; tune them with the flags):
 
 1. **Chaos-scheduled SOCKET training round** — an async host-PS
@@ -18,6 +18,12 @@ test_examples.py's scripts-coverage check; tune them with the flags):
    2), the workers fail over, commits lost must be ZERO, and the
    kill -> promote latency plus the run's commit throughput are gated
    through ``perf_regress`` (the latency lower-is-better).
+4. **Elastic reshard + receiver kill mid-move** (ISSUE 14) — an
+   elastic PS group splits and live-migrates shards under a
+   ``ps_elastic`` training run, then the RECEIVING server of a second
+   migration is killed mid-stream: the cutover aborts cleanly, the
+   old owner un-fences, commits lost must be ZERO, and the successful
+   migration's latency is ``perf_regress``-gated.
 
 The report prints, per layer: injected fault counts, client retries and
 backoff spent, commit/dedupe/snapshot counters, shed/error counts,
@@ -185,6 +191,139 @@ def failover_round(rows: int, out_dir: str) -> dict:
             "promotion_latency_s": latency, "gate": gate}
 
 
+def elastic_migration_round(rows: int, out_dir: str) -> dict:
+    """Scenario 4 (ISSUE 14): live resharding under fire.  A 2-server
+    elastic PS group serves a ``ps_elastic`` training run while an ops
+    thread (a) splits a shard, (b) migrates a shard to a freshly added
+    server (zero downtime — the cutover latency comes from the
+    ``shard_migrate_cutover`` flight event), then (c) starts a second
+    migration and KILLS the receiving server mid-stream: the cutover
+    must abort cleanly (``MigrationAborted``), the old owner must
+    un-fence, and the run must finish with ZERO lost commits.  Commit
+    throughput is gated via ``perf_regress.from_registry`` and the
+    successful migration's latency as a lower-is-better candidate."""
+    import json
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu import flight_recorder, telemetry
+    from distkeras_tpu.data import datasets
+    from distkeras_tpu.models import ModelSpec, model_config
+    from distkeras_tpu.parallel.elastic_ps import (ElasticPSGroup,
+                                                   MigrationAborted)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+    from distkeras_tpu.trainers import DOWNPOUR
+
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
+    data = datasets.synthetic_classification(rows, (8,), 4, seed=0)
+    model = ModelSpec.from_config(mlp).build()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.float32))
+    center = jax.tree_util.tree_map(np.asarray, variables["params"])
+
+    flight_recorder.start(out / "flight")
+    grp = ElasticPSGroup(DownpourRule(), center, num_shards=2,
+                         num_servers=2)
+    ops: dict = {"aborted": None, "error": None}
+    try:
+        def _wait_commits(n):
+            while grp.num_commits < n:
+                time.sleep(0.002)
+
+        def driver():
+            try:
+                _wait_commits(2)
+                plan = grp.nodes[0].map.plan
+                wide = max(range(len(plan)),
+                           key=lambda s: len(plan[s]))
+                grp.split(wide)
+                _wait_commits(4)
+                dst = grp.add_server("127.0.0.1")
+                grp.migrate(0, dst)
+                _wait_commits(6)
+                # the receiver-kill: a fresh empty server dies while
+                # the courier is streaming shard 1 into it
+                doomed = grp.add_server("127.0.0.1")
+                grp.start_migration(1, doomed)
+                grp.servers[doomed].kill()
+                try:
+                    grp.cutover(1, timeout=10.0)
+                    ops["aborted"] = False
+                except MigrationAborted:
+                    ops["aborted"] = True
+            except Exception as e:  # surface, don't hang the report
+                ops["error"] = e
+
+        th = threading.Thread(target=driver)
+        th.start()
+        t0 = time.perf_counter()
+        t = DOWNPOUR(mlp, fidelity="host", transport="socket",
+                     num_workers=2, communication_window=2,
+                     batch_size=16, num_epoch=1, learning_rate=0.01,
+                     worker_optimizer="adam", worker_retries=14,
+                     ps_elastic=True, ps_address=grp.addresses[0])
+        t.train(data)
+        seconds = time.perf_counter() - t0
+        th.join()
+        rounds = len(t.history["round_loss"])
+        commits = grp.num_commits
+        shards = grp.num_shards
+    finally:
+        grp.stop()
+    events = flight_recorder.active().read_events()
+    flight_recorder.stop()
+
+    if ops["error"] is not None:
+        raise ops["error"]
+    assert ops["aborted"], "receiver kill did not abort the cutover"
+    assert commits == rounds, (
+        f"commits lost across resharding: {commits} commits for "
+        f"{rounds} rounds")
+    assert np.isfinite(t.history["epoch_loss"]).all()
+    cutovers = [e for e in events
+                if e["kind"] == "shard_migrate_cutover"]
+    aborts = [e for e in events if e["kind"] == "shard_migrate_abort"]
+    splits = [e for e in events if e["kind"] == "shard_split"]
+    assert splits and cutovers and aborts, (
+        f"resharding story incomplete: {len(splits)} splits, "
+        f"{len(cutovers)} cutovers, {len(aborts)} aborts")
+    latency = float(cutovers[0]["latency_s"])
+
+    # ---- perf_regress hookup: shard-commit throughput from the live
+    # registry, migration latency lower-is-better
+    snap_path = out / "registry.json"
+    snap_path.write_text(json.dumps(telemetry.metrics().snapshot(),
+                                    default=repr))
+    cands = perf_regress.from_registry(
+        str(snap_path), "elastic_commits_per_sec",
+        "ps_shard_commits_total", seconds)
+    latency_cand = [{"metric": "elastic_migration_latency_s",
+                     "value": latency, "unit": "s"}]
+    for i, c in enumerate(cands + latency_cand):
+        for n in (1, 2, 3):  # synthetic trajectory from this very run
+            (out / f"BENCH_el{i}_r{n:02d}.json").write_text(
+                json.dumps({
+                    "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                    "parsed": {"metric": c["metric"],
+                               "value": c["value"] * (1 + 0.02 * n),
+                               "unit": c.get("unit", "per_sec")}}))
+    traj = perf_regress.load_trajectories(str(out / "BENCH_el*.json"))
+    gate = (perf_regress.evaluate(cands, traj, tolerance=0.5)
+            + perf_regress.evaluate(latency_cand, traj, tolerance=0.5,
+                                    lower_is_better=True))
+    assert all(r["status"] == "pass" for r in gate), gate
+    return {"rounds": rounds, "commits": commits, "shards": shards,
+            "migration_latency_s": latency,
+            "aborts": len(aborts), "gate": gate}
+
+
 def engine_overload_and_drain(seed: int) -> dict:
     """Scenario 2: bounded-queue shedding + poisoned-request isolation
     + graceful drain on a tiny LM."""
@@ -252,6 +391,9 @@ def registry_lines(tel) -> list[str]:
               "ps_snapshots_total", "ps_restarts_total",
               "ps_promotions_total", "ps_client_failovers_total",
               "ps_fenced_total", "ps_replicated_entries_total",
+              "ps_shard_fence_refresh_total", "ps_map_refresh_total",
+              "elastic_reshards_total",
+              "elastic_migrations_aborted_total",
               "serving_shed_total", "serving_request_errors_total",
               "serving_finished_total")
     for key, value in sorted(snap["counters"].items()):
@@ -289,9 +431,13 @@ def main():
 
     tel = telemetry.enable()
     # failover first: its perf_regress rate candidate reads the
-    # registry while only scenario 3's commits are in it
+    # registry while only scenario 3's commits are in it (scenario 4's
+    # gate counts ps_shard_commits_total, which nothing else touches)
     fail = failover_round(args.rows, args.out_dir or tempfile.mkdtemp(
         prefix="dkt_chaos_fo_"))
+    elastic = elastic_migration_round(
+        args.rows, args.out_dir or tempfile.mkdtemp(
+            prefix="dkt_chaos_el_"))
     train = chaos_training_round(args.seed, args.rows)
     serve = engine_overload_and_drain(args.seed)
 
@@ -324,6 +470,16 @@ def main():
         f"  promotion latency      "
         f"{fail['promotion_latency_s'] * 1e3:.1f}ms "
         "(kill -> ps_promote, perf_regress gated)",
+        "== scenario 4: elastic reshard + receiver kill mid-move ==",
+        f"  rounds completed       {elastic['rounds']}",
+        f"  commits on group       {elastic['commits']} "
+        "(== rounds: commits lost = 0 across split/migrate/abort)",
+        f"  final shard count      {elastic['shards']}",
+        f"  migration latency      "
+        f"{elastic['migration_latency_s'] * 1e3:.1f}ms "
+        "(fence -> cutover, perf_regress gated)",
+        f"  aborted moves          {elastic['aborts']} "
+        "(receiver killed mid-stream; old owner un-fenced)",
     ]
     lines += registry_lines(tel)
     report = "\n".join(lines)
@@ -333,7 +489,8 @@ def main():
                        "ps_client_retries_total",
                        "serving_request_errors_total",
                        "exactly-once held", "ps_promotions_total",
-                       "commits lost = 0"):
+                       "commits lost = 0", "migration latency",
+                       "old owner un-fenced"):
             assert needle in report, f"report lacks {needle}:\n{report}"
         report += "\nsmoke: ok"
     telemetry.disable()

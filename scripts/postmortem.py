@@ -22,6 +22,13 @@ many stale writers each epoch fenced (``ps_fenced``), cross-checked
 against the promoted replica's snapshot epoch
 (``ps_snapshot_info``'s ``epoch``).
 
+When the ring holds elastic-scaling events (ISSUE 14 —
+``autoscale_decision``, ``shard_split`` / ``shard_merge``,
+``shard_migrate_begin`` / ``_cutover`` / ``_abort``, ``replica_add`` /
+``replica_drain``), the report also replays the scaling story: every
+autoscaler decision (suppressed ones included, with the breaching
+signal and value) and every topology change, in wall-clock order.
+
 Modes:
 
 * ``--flight DIR [--seconds 30] [--snapshot ps.snap]`` — report on an
@@ -80,6 +87,51 @@ def failover_story(events: list[dict]) -> list[dict]:
     return story
 
 
+def scaling_story(events: list[dict]) -> list[dict]:
+    """The elastic-scaling timeline (ISSUE 14): one entry per scaling
+    event — autoscaler decisions (executed AND suppressed, with the
+    breaching signal), shard splits/merges, live migrations
+    (begin/cutover/abort), and gateway replica membership changes —
+    in wall-clock order, so an operator can replay exactly why the
+    topology is what it is."""
+    out = []
+    for e in sorted((e for e in events if e["kind"] in (
+            "autoscale_decision", "shard_split", "shard_merge",
+            "shard_migrate_begin", "shard_migrate_cutover",
+            "shard_migrate_abort", "replica_add", "replica_drain")),
+            key=lambda e: e["wall_s"]):
+        k = e["kind"]
+        if k == "autoscale_decision":
+            what = (f"{e['domain']}: {e['action']}"
+                    + (f" on {e['signal']}={e['value']:.4g}"
+                       if e.get("signal") else " (idle)")
+                    + (" executed" if e.get("executed")
+                       else f" suppressed ({e.get('reason')})"))
+        elif k == "shard_split":
+            what = (f"shard {e['shard']} split at leaf {e['at']} "
+                    f"-> map v{e['version']}")
+        elif k == "shard_merge":
+            what = (f"shards {e['shards']} merged "
+                    f"-> map v{e['version']}")
+        elif k == "shard_migrate_begin":
+            what = (f"shard {e['shard']} migrating "
+                    f"{e['src']} -> {e['dst']}")
+        elif k == "shard_migrate_cutover":
+            what = (f"shard {e['shard']} cut over to node {e['dst']} "
+                    f"(epoch {e['epoch']}, {e['latency_s'] * 1e3:.1f}"
+                    f"ms) -> map v{e['version']}")
+        elif k == "shard_migrate_abort":
+            what = (f"shard {e['shard']} move to node {e['dst']} "
+                    f"ABORTED ({e.get('error')}); old owner "
+                    f"un-fenced")
+        else:  # replica_add / replica_drain
+            what = (f"replica {e['replica']} "
+                    f"{'admitted' if k == 'replica_add' else 'drained'}"
+                    f" (fleet now {e['total']})")
+        out.append({"wall_s": e["wall_s"], "kind": k, "what": what})
+    return out
+
+
 def reconstruct(flight_dir: str, seconds: float = 30.0,
                 snapshot: str | None = None) -> dict:
     """The postmortem: crash marker, event window, per-worker
@@ -112,6 +164,9 @@ def reconstruct(flight_dir: str, seconds: float = 30.0,
     story = failover_story(window)
     if story:
         report["failover_story"] = story
+    scaling = scaling_story(window)
+    if scaling:
+        report["scaling_story"] = scaling
     if snapshot is not None:
         info = ps_snapshot_info(snapshot)
         report["snapshot"] = info
@@ -149,6 +204,12 @@ def render(report: dict) -> str:
             + (f" -> {end}" if end is not None else " -> crash/end")
             + (f", fenced {reign['fenced']} stale writer(s)"
                if reign["fenced"] else ""))
+    scaling = report.get("scaling_story", [])
+    if scaling:
+        lines.append(f"scaling story ({len(scaling)} events):")
+        t0 = scaling[0]["wall_s"]
+        for s in scaling:
+            lines.append(f"  +{s['wall_s'] - t0:7.3f}s {s['what']}")
     if "snapshot" in report:
         info = report["snapshot"]
         lines.append(

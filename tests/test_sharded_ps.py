@@ -144,15 +144,39 @@ def test_sharded_parity_through_kill_restart(rule, tmp_path):
     assert sha2.num_commits == n
 
 
-def test_elastic_family_gated_to_one_shard():
-    with pytest.raises(ValueError, match="elastic|num_shards=1"):
-        ShardedParameterServer(ElasticRule(alpha=0.3), _params(0), 2)
-    # K=1 elastic is the pinned, allowed case
-    ShardedParameterServer(ElasticRule(alpha=0.3), _params(0), 1)
-    with pytest.raises(ValueError, match="delta"):
-        AEASGD(MLP, fidelity="host", ps_shards=2, num_workers=2,
-               communication_window=2, batch_size=16,
-               num_epoch=1).train(DATA)
+def test_elastic_family_shards_byte_identically():
+    """The old K=1 gate is lifted (ISSUE 14): the elastic family's
+    per-leaf lerp shards exactly like the delta family — a serial
+    schedule against K=4 lands on the same bytes as the unsharded
+    server, local tree and all."""
+    rule = ElasticRule(alpha=0.3)
+    center = _params(0)
+    ref = HostParameterServer(rule, center)
+    sha = ShardedParameterServer(rule, center, 4)
+    rng = np.random.default_rng(7)
+    locals_ = {ps: {w: ps.pull(w) for w in range(3)}
+               for ps in (ref, sha)}
+    for i in range(8):
+        w = int(rng.integers(3))
+        step = jax.tree_util.tree_map(
+            lambda x: np.asarray(
+                x + rng.normal(size=x.shape).astype(x.dtype) * 0.1),
+            locals_[ref][w])
+        for ps in (ref, sha):
+            locals_[ps][w] = ps.commit(w, step, step, seq=i)
+    assert pack_params(ref.center) == pack_params(sha.center)
+    for w in range(3):
+        assert (pack_params(locals_[ref][w])
+                == pack_params(locals_[sha][w]))
+
+
+def test_elastic_family_trains_sharded():
+    """End-to-end: AEASGD at ps_shards=2 (the configuration the old
+    gate rejected) trains to a finite loss on the host arm."""
+    t = AEASGD(MLP, fidelity="host", ps_shards=2, num_workers=2,
+               communication_window=2, batch_size=16, num_epoch=1)
+    t.train(DATA)
+    assert np.isfinite(t.history["round_loss"][-1])
 
 
 def test_pull_returns_readonly_views_no_alias():

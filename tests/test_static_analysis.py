@@ -553,10 +553,11 @@ def test_wire_ops_rejects_multibyte():
 
 def test_repo_registry_covers_every_protocol():
     assert set(WIRE_OPS.scopes()) == {"frame", "ps", "replica",
-                                      "repl"}
+                                      "repl", "elastic"}
     assert WIRE_OPS.ops("ps")[b"p"] == "pull"
     assert WIRE_OPS.ops("replica")[b"g"] == "generate"
     assert WIRE_OPS.ops("repl")[b"a"] == "append"
+    assert WIRE_OPS.ops("elastic")[b"F"] == "migrate_finalize"
 
 
 # -- runtime lockset race + deadlock detector --------------------------

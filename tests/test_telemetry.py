@@ -460,7 +460,8 @@ def test_slo_watchdog_thresholds_and_transitions(tel):
                               thresholds={"retry_rate": (2.0, 1.0)})
 
     w = telemetry.SLOWatchdog(reg)
-    assert w.evaluate() == {"state": "ok", "signals": {},
+    assert w.evaluate() == {"state": "ok", "raw_state": "ok",
+                            "signals": {},
                             "breaches": {}}  # no traffic != outage
     h = reg.histogram("ps_commit_staleness",
                       buckets=telemetry.STALENESS_BUCKETS)
