@@ -301,7 +301,7 @@ class EngineReplica:
         _, spec, cb = cmd
         kwargs = {}
         for k in ("max_new_tokens", "eos_id", "deadline", "meta",
-                  "tenant", "priority"):
+                  "tenant", "priority", "speculative"):
             if k in spec:
                 kwargs[k] = spec[k]
         try:
@@ -858,11 +858,15 @@ class ServingGateway:
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                eos_id=_UNSET, request_id=None, deadline=_UNSET,
                session=None, meta: Optional[Mapping] = None,
-               tenant=None, priority: Optional[int] = None):
+               tenant=None, priority: Optional[int] = None,
+               speculative=None):
         """Queue one request; returns its id.  ``session`` is the
         affinity key for the ``session`` policy; ``tenant``/
         ``priority`` ride through to the engine's QoS scheduler
-        (inert on envelope-pool replicas).  Explicit ``request_id``s
+        (inert on envelope-pool replicas); ``speculative`` is the
+        per-request speculation override, forwarded only when set
+        (replicas without an engine-level ``speculative=`` config
+        reject it).  Explicit ``request_id``s
         must be unique among unresolved gateway requests (and
         msgpack-encodable for remote replicas)."""
         self.start()
@@ -882,6 +886,8 @@ class ServingGateway:
             spec["tenant"] = tenant
         if priority is not None:
             spec["priority"] = int(priority)
+        if speculative is not None:
+            spec["speculative"] = bool(speculative)
         with self._lock:
             if self._closing:
                 raise RuntimeError("gateway is closed")
@@ -917,8 +923,8 @@ class ServingGateway:
         """Serve an iterable to completion — the gateway-level
         ``DecodeEngine.run``.  Items are prompts or mappings with
         ``"prompt"`` (+ ``max_new_tokens``/``eos_id``/``session``/
-        ``deadline``/``tenant``/``priority``; other keys ride into
-        results as meta).  Engine
+        ``deadline``/``tenant``/``priority``/``speculative``; other
+        keys ride into results as meta).  Engine
         sheds are absorbed by the failover/backoff machinery, so the
         whole iterable is always accounted for: one result per item.
         """
@@ -942,7 +948,7 @@ class ServingGateway:
             meta = {k: v for k, v in item.items()
                     if k not in ("prompt", "max_new_tokens", "eos_id",
                                  "session", "deadline", "tenant",
-                                 "priority")}
+                                 "priority", "speculative")}
             return self.submit(
                 item["prompt"],
                 max_new_tokens=item.get("max_new_tokens"),
@@ -950,7 +956,8 @@ class ServingGateway:
                 deadline=item.get("deadline", _UNSET),
                 session=item.get("session"),
                 tenant=item.get("tenant"),
-                priority=item.get("priority"), meta=meta)
+                priority=item.get("priority"),
+                speculative=item.get("speculative"), meta=meta)
         return self.submit(item)
 
     # -- routing ------------------------------------------------------

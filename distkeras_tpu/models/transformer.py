@@ -534,7 +534,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, *,
-                 slot_pos=None, last_index=None):
+                 slot_pos=None, last_index=None,
+                 logits_all: bool = False):
         import jax.lax as lax
 
         dtype = jnp.dtype(self.dtype)
@@ -580,12 +581,17 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 "cache_envelope sizes the decode-mode KV cache; it "
                 "has no meaning without decode=True")
-        if (slot_pos is not None or last_index is not None) \
-                and not self.decode:
+        if (slot_pos is not None or last_index is not None
+                or logits_all) and not self.decode:
             raise ValueError(
-                "slot_pos/last_index are decode-mode serving "
-                "contracts (per-slot cache positions / right-padded "
-                "prompt logit row); set decode=True")
+                "slot_pos/last_index/logits_all are decode-mode "
+                "serving contracts (per-slot cache positions / "
+                "right-padded prompt logit row / speculative verify); "
+                "set decode=True")
+        if logits_all and last_index is not None:
+            raise ValueError(
+                "logits_all returns every position's logits; "
+                "last_index selects one — pass at most one of them")
         if slot_pos is not None and t != 1:
             raise ValueError(
                 "slot_pos advances every live slot by ONE token; got "
@@ -694,8 +700,12 @@ class TransformerLM(nn.Module):
             # would read a pad position's logits).
             if last_index is not None:
                 x = lax.dynamic_slice_in_dim(x, last_index, 1, 1)
-            else:
+            elif not logits_all:
                 x = x[:, -1:]
+            # logits_all: the speculative-verify contract — every
+            # position's logits survive to the lm_head (T is the
+            # small proposal window k+1 there, so the full-vocab f32
+            # head stays cheap)
         x = nn.LayerNorm(dtype=dtype)(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         name="lm_head")(x)

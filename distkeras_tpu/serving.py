@@ -101,6 +101,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import flight_recorder, paging, telemetry
+from distkeras_tpu import speculative as _speculative
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
@@ -129,7 +130,7 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "meta",
                  "submit_order", "t_submit", "t_first", "deadline",
                  "prefix_path", "weights_ver", "tenant", "priority",
-                 "pages", "swap")
+                 "pages", "swap", "spec_on")
 
     def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order,
                  deadline=None, tenant=None, priority=1):
@@ -151,6 +152,19 @@ class _Request:
         self.priority = priority       # QoS: 0 (lowest) .. 2 (highest)
         self.pages: list[int] = []     # paged mode: held page ids
         self.swap = None               # parked: host KV / restore plan
+        self.spec_on = None            # per-request speculative
+        #                                override (None: engine config)
+
+    def ledger(self, env: Optional[int] = None) -> np.ndarray:
+        """The slot's ONE retained-token ledger: prompt + every
+        generated token, most-recent-``env`` truncated when an
+        envelope is given.  Both consumers — the recompute-preemption
+        readmission arm and the n-gram drafter — read exactly this
+        (the pre-speculation engine kept two copies of the
+        truncation logic)."""
+        ext = np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+        return ext if env is None else ext[-env:]
 
 
 class _PrefixNode:
@@ -270,7 +284,7 @@ class _Pool:
     __slots__ = ("env", "n_slots", "dec", "cache", "state", "reqs",
                  "step_fn", "prefill_fn", "queue", "chunk_fn",
                  "copy_fn", "extract_fn", "prefilling", "cache_tmpl",
-                 "table", "table_np")
+                 "table", "table_np", "spec")
 
     def __init__(self, env, n_slots, dec):
         self.env = env
@@ -384,6 +398,26 @@ class DecodeEngine:
         ``None``: off).  A quota-blocked request waits in the queue
         while others admit past it — quotas cannot be fixed by
         preemption.
+      speculative: speculative-decoding config (``None``: off) — a
+        mapping with ``proposer`` (``"ngram"``: model-free
+        prompt-lookup over the slot's token ledger; ``"draft"``: a
+        smaller same-vocab model with its own per-pool envelope KV),
+        ``k`` (proposal window, default 4), ``ngram`` (match length,
+        default 2), and for the draft proposer ``draft_model`` +
+        ``draft_variables``.  Each step, every eligible slot's
+        proposer guesses up to ``k`` tokens and ONE dense verify
+        pass scores all ``k + 1`` positions (the chunk-prefill
+        machinery with ``logits_all``); the longest prefix the
+        target model itself would have produced is committed plus
+        one bonus token, the rest rolled back by rewinding the slot
+        position (envelope) or freeing tail page-table entries
+        (paged) — greedy output is byte-identical to the
+        non-speculative engine by construction.  Requires
+        ``temperature=0.0`` and ``steps_per_sync=1``; composes with
+        chunked prefill, the prefix store, preemption (draft KV is
+        recompute-class, never swapped), and ``swap_variables``
+        (drafts are invalidated with the weights version).
+        ``submit(speculative=False)`` opts a request out.
     """
 
     def __init__(self, model, variables: Mapping, *, slots: int = 8,
@@ -401,7 +435,8 @@ class DecodeEngine:
                  page_size: Optional[int] = None,
                  preemption: str = "swap",
                  recompute_below: int = 0,
-                 tenant_quota=None):
+                 tenant_quota=None,
+                 speculative=None):
         base = _decode_model(model)
         self.max_len = base.max_len
         self.vocab_size = base.vocab_size
@@ -471,6 +506,20 @@ class DecodeEngine:
             raise ValueError(
                 f"tenant_quota must be >= 1 pages (or a mapping, or "
                 f"None); got {tenant_quota}")
+        spec = _speculative.normalize(speculative,
+                                      vocab_size=self.vocab_size,
+                                      max_len=self.max_len)
+        if spec is not None:
+            if float(temperature) != 0.0:
+                raise ValueError(
+                    "speculative decoding requires temperature=0.0 — "
+                    "the acceptance rule is the greedy one (byte-"
+                    f"identical output); got {temperature}")
+            if steps_per_sync != 1:
+                raise ValueError(
+                    "speculative decoding requires steps_per_sync=1 — "
+                    "a verify already commits up to k+1 tokens per "
+                    f"host sync; got {steps_per_sync}")
         if buckets is None:
             buckets = {self.max_len: slots}
         elif isinstance(buckets, Mapping):
@@ -527,6 +576,14 @@ class DecodeEngine:
         self._page_copy_fn = None
         self._page_extract_fn = None
         self._weights_ver = 0  # guarded-by: _lock
+        self._spec = spec
+        self._spec_proposed = 0  # host mirrors of the spec counters
+        self._spec_accepted = 0
+        if spec is not None and spec["draft_model"] is not None:
+            # device_put once: the draft weights ride every propose/
+            # prefill dispatch and must not re-transfer per call
+            spec["draft_variables"] = jax.tree_util.tree_map(
+                jnp.asarray, spec["draft_variables"])
         self._key = jax.random.key(seed)
         self._n_rng = 0
         self._n_submitted = 0
@@ -587,6 +644,14 @@ class DecodeEngine:
         pool.prefill_fn = self._make_prefill(pool)
         pool.chunk_fn = (self._make_chunk_prefill(pool)
                          if self._segmented else None)
+        if self._spec is not None:
+            k = self._spec["k"]
+            pool.spec = {"verify_fns": {
+                w: self._make_verify(pool, w) for w in (1, k + 1)}}
+            if self._spec["draft_model"] is not None:
+                pool.spec.update(self._init_draft(pool))
+        else:
+            pool.spec = None
         if self._paged:
             # paged prefix install/donation go page-direct (bucket-
             # independent shapes: ONE compiled pair for all pools)
@@ -833,6 +898,128 @@ class DecodeEngine:
         donate = (1, 3) if self._donate else ()
         return jax.jit(paged_chunk_impl, donate_argnums=donate)
 
+    def _make_verify(self, pool: _Pool, width: int):
+        """The speculative VERIFY program: one dense-attention pass
+        over a ``[1, width]`` chunk — ``[last committed token,
+        proposal_1 .. proposal_{width-1}]`` — sliced into the slot's
+        envelope at the scalar cache offset (exactly the chunk-
+        prefill machinery), but with ``logits_all`` so EVERY
+        position's greedy argmax comes back: ``greedy[j]`` is what
+        the target model itself generates after proposal ``j`` tokens
+        of the window, which is simultaneously the acceptance oracle
+        for proposal ``j+1`` and the bonus token when acceptance ends
+        at ``j``.  K/V rows for rejected proposals are left in place
+        and rolled back by rewinding the slot position — the standing
+        write-before-read argument makes the stale rows dead.  Two
+        widths exist per bucket (``k + 1`` and the single-token
+        fallback), so the compiled program set stays bounded."""
+        env = pool.env
+        dense = pool.dec.clone(attn="dense", attn_fn=None,
+                               flash_attn=False, blockwise_attn=False)
+
+        def verify_core(variables, cache, chunk, slot, start):
+            params = {"params": variables["params"]}
+
+            def pick(leaf):
+                if jnp.ndim(leaf) == 0:  # cache/pos index: the offset
+                    return jnp.asarray(start, leaf.dtype)
+                return jax.lax.dynamic_slice(
+                    leaf, (slot,) + (0,) * (leaf.ndim - 1),
+                    (1,) + leaf.shape[1:])
+
+            sub = jax.tree_util.tree_map(pick, cache)
+            logits, st = dense.apply({**params, "cache": sub}, chunk,
+                                     mutable=["cache"],
+                                     logits_all=True)
+            greedy = jnp.argmax(logits[0].astype(jnp.float32),
+                                axis=-1).astype(jnp.int32)
+
+            def merge(pool_leaf, new_leaf):
+                if jnp.ndim(new_leaf) == 0:
+                    return pool_leaf
+                return jax.lax.dynamic_update_slice(
+                    pool_leaf, new_leaf,
+                    (slot,) + (0,) * (new_leaf.ndim - 1))
+
+            cache = jax.tree_util.tree_map(merge, cache, st["cache"])
+            return cache, greedy
+
+        if not self._paged:
+            def verify_impl(variables, cache, chunk, slot, start):
+                self._traces["verify", env, width] += 1
+                telemetry.metrics().counter(
+                    "compiles_total", kind="verify", bucket=env,
+                    padded=width).inc()
+                return verify_core(variables, cache, chunk, slot,
+                                   start)
+
+            donate = (1,) if self._donate else ()
+            return jax.jit(verify_impl, donate_argnums=donate)
+
+        tmpl = pool.cache_tmpl
+
+        def paged_verify_impl(variables, pages, table, chunk, slot,
+                              start):
+            self._traces["paged_verify", env, width] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="paged_verify", bucket=env,
+                padded=width).inc()
+            cache = paging.gather_cache(tmpl, pages, table)
+            cache, greedy = verify_core(variables, cache, chunk,
+                                        slot, start)
+            return paging.scatter_cache(pages, cache, table), greedy
+
+        donate = (1,) if self._donate else ()
+        return jax.jit(paged_verify_impl, donate_argnums=donate)
+
+    def _init_draft(self, pool: _Pool) -> dict:
+        """Per-pool draft-proposer state: the draft model cloned at
+        the bucket envelope, its own ``[slots, ...]`` ENVELOPE cache
+        (never paged — draft KV is recompute-class state, rebuilt
+        from the token ledger whenever invalidated, so the paged
+        pool's swap machinery has nothing to preserve), host mirrors
+        of each slot's draft feed token / position (``dpos == -1``
+        means invalid: rebuild before proposing), and the compiled
+        propose/prefill programs under the engine's compile guard."""
+        s = pool.n_slots
+        base = self._spec["draft_model"]
+        ddec = (base if pool.env == base.max_len
+                else base.clone(cache_envelope=pool.env))
+        dshapes = jax.eval_shape(
+            lambda v: ddec.apply(v, jnp.zeros((s, 1), jnp.int32),
+                                 mutable=["cache"]),
+            {"params": self._spec["draft_variables"]["params"]}
+        )[1]["cache"]
+        dcache = jax.tree_util.tree_map(
+            lambda sh: jnp.zeros(sh.shape, sh.dtype), dshapes)
+        env, k = pool.env, self._spec["k"]
+
+        def note_step():
+            self._traces["draft_step", env] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="draft_step", bucket=env).inc()
+
+        def note_prefill(t_pad):
+            self._traces["draft_prefill", env, t_pad] += 1
+            telemetry.metrics().counter(
+                "compiles_total", kind="draft_prefill", bucket=env,
+                padded=t_pad).inc()
+
+        donate = (1,) if self._donate else ()
+        return {
+            "dec": ddec, "cache": dcache,
+            "dtok": np.full((s,), self.pad_id, np.int32),
+            "dpos": np.full((s,), -1, np.int32),
+            "propose_fn": jax.jit(
+                _speculative.make_draft_propose(
+                    ddec, env, k, self.pad_id, on_trace=note_step),
+                donate_argnums=donate),
+            "prefill_fn": jax.jit(
+                _speculative.make_draft_prefill(
+                    ddec, on_trace=note_prefill),
+                donate_argnums=donate),
+        }
+
     def _make_page_copy(self):
         """Prefix-store install in paged mode: write one cached
         ``align``-row segment straight into an allocated page — the
@@ -925,7 +1112,7 @@ class DecodeEngine:
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                eos_id=_UNSET, request_id=None, deadline=_UNSET,
                meta: Optional[Mapping] = None, tenant=None,
-               priority: int = 1):
+               priority: int = 1, speculative=None):
         """Queue one request; returns its id (auto-assigned if None).
 
         ``max_new_tokens``/``eos_id``/``deadline`` default to the
@@ -942,6 +1129,14 @@ class DecodeEngine:
         page quotas are enforced at admission, and on pool exhaustion
         a higher-priority request preempts the lowest-priority live
         one instead of waiting behind it.
+
+        ``speculative`` is the per-request override of the engine's
+        speculative-decoding config: ``None`` follows the engine,
+        ``False`` opts this request out (it decodes via the
+        single-token verify — still byte-identical), ``True`` is an
+        explicit opt-in and REQUIRES the engine to be configured
+        with ``speculative=`` (rejected here otherwise — a silent
+        no-op would hide a misconfigured client).
         """
         if self._closed:
             raise RuntimeError("engine is closed; submit after close()")
@@ -968,6 +1163,10 @@ class DecodeEngine:
         if not isinstance(priority, int) or not 0 <= priority <= 2:
             raise ValueError(
                 f"priority must be an int in 0..2; got {priority!r}")
+        if speculative and self._spec is None:
+            raise ValueError(
+                "submit(speculative=True) needs an engine built with "
+                "speculative=...; this engine has speculation off")
         pool = self._route(len(prompt), max_new)
         if self._paged:
             # worst-case page footprint must fit the pool AND the
@@ -1021,6 +1220,8 @@ class DecodeEngine:
                            dict(meta or {}), self._n_submitted,
                            deadline=dl, tenant=tenant,
                            priority=priority)
+            if speculative is not None:
+                req.spec_on = bool(speculative)
             self._n_submitted += 1
             self._inflight.add(rid)
             pool.queue.append(req)
@@ -1094,6 +1295,13 @@ class DecodeEngine:
             self._weights_ver += 1
             if self._prefix is not None:
                 inval = self._prefix.clear()
+            # in-flight DRAFTS are invalidated with the weights
+            # version too: every slot's draft cache is rebuilt from
+            # its token ledger before the next propose, so no
+            # proposal spans the swap boundary
+            for pool in self._pools:
+                for slot in range(pool.n_slots):
+                    self._draft_invalidate(pool, slot)
         telemetry.metrics().counter("serving_weight_swaps_total").inc()
         telemetry.instant("weight_swap")
         flight_recorder.record("weight_swap",
@@ -1243,6 +1451,8 @@ class DecodeEngine:
         else:
             req.swap = {"mode": "recompute", "pool": pool}
         pool.reqs[slot] = None
+        # draft KV is recompute-class: never part of the swap plan
+        self._draft_invalidate(pool, slot)
         # parked requests re-match the store at readmission; holding
         # pins while parked would block eviction for no reader
         self._prefix_unpin(req)
@@ -1359,19 +1569,20 @@ class DecodeEngine:
                         k: v.at[slot].set(swap["state"][k])
                         for k, v in pool.state.items()}
                 pool.reqs[slot] = req
+                # the TARGET restore is page-exact; the draft cache
+                # for this slot is whatever its last tenant left
+                self._draft_invalidate(pool, slot)
             else:
                 req.swap = None
                 req.weights_ver = self._weights_ver
-                ext = np.concatenate(
-                    [req.prompt,
-                     np.asarray(req.tokens, np.int32)])
                 # a request preempted past its envelope was rolling
                 # over row env-1; recompute keeps the most recent
-                # env tokens (the rolled state is unrecoverable by
-                # construction — swap mode preserves it exactly)
-                ext = ext[-pool.env:]
+                # env tokens of the ledger (the rolled state is
+                # unrecoverable by construction — swap mode
+                # preserves it exactly)
                 out.extend(self._prefill_whole(
-                    pool, slot, req, variables, prompt_override=ext))
+                    pool, slot, req, variables,
+                    prompt_override=req.ledger(pool.env)))
             self._note_gauges(pool)
         return out
 
@@ -1498,6 +1709,7 @@ class DecodeEngine:
         every token generated before preemption, and the budget
         accounting continues from where the request left off."""
         m = telemetry.metrics()
+        self._draft_invalidate(pool, slot)  # new slot tenant
         prompt = (req.prompt if prompt_override is None
                   else prompt_override)
         t_p = len(prompt)
@@ -1555,6 +1767,7 @@ class DecodeEngine:
         to the legacy one-shot program — same compiled shapes, same
         admission latency."""
         m = telemetry.metrics()
+        self._draft_invalidate(pool, slot)  # new slot tenant
         t_p = len(req.prompt)
         t_pad = min(pool.env, _ceil_to(t_p, self.prefill_align))
         align = self.prefill_align
@@ -1825,6 +2038,244 @@ class DecodeEngine:
                 "t_finish": t_finish, "ttft": ttft,
                 "latency": t_finish - req.t_submit}
 
+    # ---- speculative decode -------------------------------------------
+
+    def _commit_tokens(self, req: _Request,
+                       cand: list) -> tuple[int, bool]:
+        """Append candidate tokens under the PER-TOKEN stop scan: the
+        ``max_new`` clamp and the ``eos_id`` check apply to EVERY
+        committed token — generation stops mid-window and the tail of
+        an accepted run is discarded, exactly the rule the one-token
+        step loop applies per step.  Returns ``(committed,
+        finished)``."""
+        c = 0
+        for t in cand:
+            req.tokens.append(int(t))
+            c += 1
+            if (len(req.tokens) >= req.max_new
+                    or req.tokens[-1] == req.eos_id):
+                return c, True
+        return c, False
+
+    def _spec_grow(self, pool: _Pool, slot: int, req: _Request,
+                   start: int, width: int) -> bool:
+        """Cover rows ``[0, start + width)`` before a WIDE verify.
+        The widening allocation is opportunistic — no preemption: a
+        shortage (pool or tenant quota) falls back to the single-
+        token verify, whose one write row standard ``_grow_pages``
+        growth already covered, so speculation degrades to baseline
+        throughput instead of evicting a neighbor."""
+        need = paging.pages_for(min(pool.env, start + width),
+                                self.page_size)
+        extra = need - len(req.pages)
+        if extra <= 0:
+            return True
+        if not self._alloc.fits_quota(extra, req.tenant):
+            return False
+        pids = self._alloc_pages(extra, req.tenant)
+        if pids is None:
+            return False
+        req.pages.extend(pids)
+        self._set_table_row(pool, slot, req.pages)
+        return True
+
+    def _spec_rewind(self, pool: _Pool, slot: int, req: _Request,
+                     pos_next: int) -> int:
+        """Roll rejected speculation back in the PAGE TABLE: pages
+        past the committed frontier (``pos_next`` is the next write
+        row, so ``pos_next + 1`` rows stay covered) return to the
+        allocator and their table entries to the garbage page.  The
+        padded-prompt floor is kept — prefix donation slices prompt
+        pages at finish — and freed pages may be re-earned by a later
+        ``_spec_grow``, always within the worst case ``submit()``
+        validated."""
+        t_pad = min(pool.env,
+                    _ceil_to(len(req.prompt), self.prefill_align))
+        keep = max(
+            paging.pages_for(min(pool.env, pos_next + 1),
+                             self.page_size),
+            paging.pages_for(t_pad, self.page_size))
+        if len(req.pages) <= keep:
+            return 0
+        drop = req.pages[keep:]
+        del req.pages[keep:]
+        self._alloc.free(drop, req.tenant)
+        telemetry.metrics().counter(
+            "serving_pages_freed_total").inc(len(drop))
+        self._set_table_row(pool, slot, req.pages)
+        return len(drop)
+
+    def _draft_invalidate(self, pool: _Pool, slot: int) -> None:
+        """Mark one slot's draft cache stale (rebuild-from-ledger at
+        the next propose).  Draft KV is always recompute-class: slot
+        turnover, preemption, swap-mode restore, and weight swaps all
+        land here instead of any host round-trip."""
+        if pool.spec is not None and "dpos" in pool.spec:
+            pool.spec["dpos"][slot] = -1
+
+    def _draft_propose(self, pool: _Pool, variables,
+                       elig: dict) -> dict:
+        """Draft-model proposals for every eligible slot: first
+        rebuild any invalidated slot's draft cache from its token
+        ledger (one bounded-shape prefill — the recompute-class
+        contract), then ONE batched compiled program runs ``k + 1``
+        cached greedy draft steps for all slots at once.  Returns
+        ``{slot: k proposals}`` for the slots that were drafted."""
+        d = pool.spec
+        dvars = self._spec["draft_variables"]
+        k = self._spec["k"]
+        for s, ok in sorted(elig.items()):
+            if not ok or d["dpos"][s] >= 0:
+                continue
+            req = pool.reqs[s]
+            ledger = req.ledger(pool.env)
+            if len(ledger) >= 2:
+                t_pad = min(pool.env,
+                            _ceil_to(len(ledger) - 1,
+                                     self.prefill_align))
+                padded = np.full((1, t_pad), self.pad_id, np.int32)
+                padded[0, :len(ledger) - 1] = ledger[:-1]
+                with telemetry.span("draft_prefill", bucket=pool.env,
+                                    slot=s, padded=t_pad,
+                                    request_id=req.rid):
+                    d["cache"] = d["prefill_fn"](
+                        dvars, d["cache"], jnp.asarray(padded), s)
+            d["dpos"][s] = len(ledger) - 1
+            d["dtok"][s] = ledger[-1]
+        live = np.array([bool(elig.get(s)) and d["dpos"][s] >= 0
+                         for s in range(pool.n_slots)])
+        if not live.any():
+            return {}
+        with telemetry.span("draft_step", bucket=pool.env, k=k):
+            d["cache"], props = d["propose_fn"](
+                dvars, d["cache"], jnp.asarray(d["dtok"]),
+                jnp.asarray(d["dpos"]), jnp.asarray(live))
+            props = np.asarray(props)
+        return {s: props[:, s] for s in range(pool.n_slots)
+                if live[s]}
+
+    def _spec_decode(self, pool: _Pool, variables) -> list[dict]:
+        """One speculative decode quantum for a pool — the spec-mode
+        replacement for the batched step dispatch.  Per live slot:
+        propose up to ``k`` tokens (n-gram ledger lookup or the
+        batched draft program), verify the whole window in one dense
+        pass, commit the longest accepted prefix plus the bonus token
+        under the per-token stop scan, and roll the rejected tail
+        back (position rewind; paged mode also returns tail pages).
+        A slot with no proposal (or out of budget/pages, or opted
+        out) runs the single-token verify — byte-identical to the
+        baseline step for that slot."""
+        spec = self._spec
+        k = spec["k"]
+        m = telemetry.metrics()
+        finished: list[dict] = []
+        slots = [s for s, r in enumerate(pool.reqs)
+                 if r is not None and s not in pool.prefilling]
+        if not slots:
+            return finished
+        # WIDE-verify eligibility: the whole k+1 window must fit the
+        # remaining budget — which, with the routing invariant
+        # t_p + max_new <= env, also bounds every row the verify and
+        # draft programs write to env - 2 (no envelope overflow, no
+        # page demand past what submit() validated)
+        elig = {s: (pool.reqs[s].spec_on is not False
+                    and pool.reqs[s].max_new
+                    - len(pool.reqs[s].tokens) > k)
+                for s in slots}
+        props: dict = {}
+        if spec["draft_model"] is not None and any(elig.values()):
+            props = self._draft_propose(pool, variables, elig)
+        n_tok = 0
+        for s in slots:
+            req = pool.reqs[s]
+            ledger = req.ledger(pool.env)
+            start = len(ledger) - 1
+            p = np.empty((0,), np.int32)
+            if elig[s]:
+                if spec["draft_model"] is None:
+                    p = _speculative.ngram_propose(ledger, k,
+                                                   spec["ngram"])
+                else:
+                    p = props.get(s, p)
+            width = k + 1 if len(p) else 1
+            if (width > 1 and self._paged
+                    and not self._spec_grow(pool, s, req, start,
+                                            width)):
+                p = p[:0]  # page-short: degrade to the 1-wide verify
+                width = 1
+            chunk = np.full((1, width), self.pad_id, np.int32)
+            chunk[0, 0] = ledger[-1]
+            chunk[0, 1:1 + len(p)] = p
+            try:
+                with telemetry.span("verify", bucket=pool.env,
+                                    slot=s, width=width,
+                                    request_id=req.rid):
+                    vf = pool.spec["verify_fns"][width]
+                    if self._paged:
+                        self._pages, greedy = vf(
+                            variables, self._pages, pool.table,
+                            jnp.asarray(chunk), s, start)
+                    else:
+                        pool.cache, greedy = vf(
+                            variables, pool.cache,
+                            jnp.asarray(chunk), s, start)
+                    greedy = np.asarray(greedy)
+            except Exception as e:
+                # same per-request isolation contract as prefill
+                pool.reqs[s] = None
+                self._release_pages(req, pool, s)
+                finished.append(self._finish_error(
+                    req, f"verify_failed: {e!r}", pool.env))
+                continue
+            n = _speculative.accept_length(p, greedy)
+            c, fin = self._commit_tokens(
+                req, [int(x) for x in p[:n]] + [int(greedy[n])])
+            n_tok += c
+            if len(p):
+                self._spec_proposed += len(p)
+                self._spec_accepted += n
+                m.counter("serving_spec_proposed_total",
+                          bucket=pool.env).inc(len(p))
+                m.counter("serving_spec_accepted_total",
+                          bucket=pool.env).inc(n)
+                m.histogram("serving_spec_accept_len").observe(n)
+                m.gauge("serving_spec_accept_rate").set(
+                    self._spec_accepted
+                    / max(self._spec_proposed, 1))
+                rejected = len(p) - n
+                if rejected:
+                    freed = (0 if fin or not self._paged
+                             else self._spec_rewind(pool, s, req,
+                                                    start + c))
+                    flight_recorder.record(
+                        "spec_rollback", request_id=req.rid,
+                        bucket=pool.env, rejected=rejected,
+                        pages_freed=freed)
+            if spec["draft_model"] is not None and not fin:
+                # commit keeps the draft exactly one token behind the
+                # ledger (the k+1-step propose wrote every accepted
+                # row's draft K/V), so only the host mirrors move
+                pool.spec["dpos"][s] = start + c
+                pool.spec["dtok"][s] = req.tokens[-1]
+            if fin:
+                finished.append(self._finish(pool, s))
+        if n_tok:
+            m.counter("serving_tokens_total",
+                      bucket=pool.env).inc(n_tok)
+        return finished
+
+    def spec_stats(self) -> dict:
+        """Host-side speculative-decoding counters (operator
+        introspection; the same numbers feed the metrics registry
+        and the ``spec_accept_rate`` SLO signal)."""
+        if self._spec is None:
+            return {"enabled": False}
+        p, a = self._spec_proposed, self._spec_accepted
+        return {"enabled": True,
+                "proposer": self._spec["proposer"],
+                "k": self._spec["k"], "proposed": p, "accepted": a,
+                "accept_rate": (a / p) if p else None}
+
     # ---- serving loop -------------------------------------------------
 
     def has_work(self) -> bool:
@@ -1861,38 +2312,47 @@ class DecodeEngine:
                 finished.extend(self._grow_pages(pool))
             if not pool.decodable():
                 continue
-            # the span covers dispatch AND the host sync (np.asarray),
-            # so its duration is the true step-quantum latency
-            with telemetry.span("decode_step", bucket=pool.env,
-                                steps=self.steps_per_sync):
-                if self._paged:
-                    (self._pages, pool.state, toks,
-                     was_done) = pool.step_fn(
-                        variables, self._pages, pool.table,
-                        pool.state, self._next_rng())
-                else:
-                    (pool.cache, pool.state, toks,
-                     was_done) = pool.step_fn(
-                        variables, pool.cache, pool.state,
-                        self._next_rng())
-                toks = np.asarray(toks)
-                was_done = np.asarray(was_done)
-            n_tok = 0
-            for slot, req in enumerate(pool.reqs):
-                if req is None:
-                    continue
-                for k in range(toks.shape[0]):
-                    if was_done[k, slot]:
-                        break
-                    req.tokens.append(int(toks[k, slot]))
-                    n_tok += 1
-                    if (len(req.tokens) >= req.max_new
-                            or req.tokens[-1] == req.eos_id):
-                        finished.append(self._finish(pool, slot))
-                        break
-            if n_tok:
-                m.counter("serving_tokens_total",
-                          bucket=pool.env).inc(n_tok)
+            if self._spec is not None:
+                # speculative mode replaces the batched one-token
+                # dispatch with per-slot propose + verify (commits up
+                # to k+1 tokens per slot per step); the deadline
+                # sweep below is shared, so expiry mid-verify still
+                # frees the slot this same step
+                finished.extend(self._spec_decode(pool, variables))
+            else:
+                # the span covers dispatch AND the host sync
+                # (np.asarray), so its duration is the true
+                # step-quantum latency
+                with telemetry.span("decode_step", bucket=pool.env,
+                                    steps=self.steps_per_sync):
+                    if self._paged:
+                        (self._pages, pool.state, toks,
+                         was_done) = pool.step_fn(
+                            variables, self._pages, pool.table,
+                            pool.state, self._next_rng())
+                    else:
+                        (pool.cache, pool.state, toks,
+                         was_done) = pool.step_fn(
+                            variables, pool.cache, pool.state,
+                            self._next_rng())
+                    toks = np.asarray(toks)
+                    was_done = np.asarray(was_done)
+                n_tok = 0
+                for slot, req in enumerate(pool.reqs):
+                    if req is None:
+                        continue
+                    for k in range(toks.shape[0]):
+                        if was_done[k, slot]:
+                            break
+                        req.tokens.append(int(toks[k, slot]))
+                        n_tok += 1
+                        if (len(req.tokens) >= req.max_new
+                                or req.tokens[-1] == req.eos_id):
+                            finished.append(self._finish(pool, slot))
+                            break
+                if n_tok:
+                    m.counter("serving_tokens_total",
+                              bucket=pool.env).inc(n_tok)
             # live requests past their deadline free the slot NOW —
             # graceful degradation under a stuck/slow decode rather
             # than holding capacity for an answer nobody will take
@@ -1947,6 +2407,7 @@ class DecodeEngine:
                             req, "engine_closed", pool.env))
                 pool.prefilling.clear()
                 pool.cache = pool.state = None  # release the pool
+                pool.spec = None  # draft cache + verify programs too
                 if self._paged:
                     pool.table = pool.table_np = None
                 self._note_gauges(pool)
@@ -1982,13 +2443,15 @@ class DecodeEngine:
         if isinstance(item, Mapping):
             meta = {k: v for k, v in item.items()
                     if k not in ("prompt", "max_new_tokens",
-                                 "eos_id", "tenant", "priority")}
+                                 "eos_id", "tenant", "priority",
+                                 "speculative")}
             return self.submit(
                 item["prompt"],
                 max_new_tokens=item.get("max_new_tokens"),
                 eos_id=item.get("eos_id", _UNSET),
                 tenant=item.get("tenant"),
-                priority=item.get("priority", 1), meta=meta)
+                priority=item.get("priority", 1),
+                speculative=item.get("speculative"), meta=meta)
         return self.submit(item)
 
     def run(self, requests: Iterable, *, ordered: bool = True

@@ -1002,6 +1002,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
     "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
     "preemption_rate": (0.25, 2.0),       # preemptions per request
+    "spec_accept_rate": (0.20, 0.05),     # accepted / proposed tokens
 }
 
 #: Signals where LOW is bad: the comparison inverts (breach at/below
@@ -1009,7 +1010,12 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
 #: ``degraded_at >= critical_at``.  A collapsed prefix hit rate on a
 #: shared-prompt workload means admissions silently pay full prefill
 #: again (store thrash, post-swap cold start, or misrouted affinity).
-LOWER_IS_WORSE_SLO_SIGNALS = frozenset({"prefix_hit_rate"})
+#: A collapsed speculative accept rate means every engine step pays
+#: the proposer AND the wide verify for baseline-or-worse throughput
+#: — the workload stopped matching the proposer (turn speculation
+#: off, shrink k, or switch proposers).
+LOWER_IS_WORSE_SLO_SIGNALS = frozenset({"prefix_hit_rate",
+                                        "spec_accept_rate"})
 
 
 def _merged_percentile(registry, name: str, q: float) -> float | None:
@@ -1034,8 +1040,8 @@ class SLOWatchdog:
     The signals (PS staleness p99, client retry rate, serving shed
     rate, queue depth, TTFT p95, idle-worker fraction, gateway
     failover rate, prefix hit rate, PS standby replication lag,
-    KV-page preemption rate) are computed from the registry's
-    live metrics and compared against ``(degraded_at, critical_at)``
+    KV-page preemption rate, speculative accept rate) are computed
+    from the registry's live metrics and compared against ``(degraded_at, critical_at)``
     thresholds — inverted for ``LOWER_IS_WORSE_SLO_SIGNALS``, where a
     LOW value breaches; the worst breach decides
     the ``ok`` / ``degraded`` / ``critical`` state.  ``evaluate()`` is
@@ -1144,6 +1150,14 @@ class SLOWatchdog:
             # inverted signal (see LOWER_IS_WORSE_SLO_SIGNALS) — a
             # LOW rate on a shared-prefix workload is the breach
             out["prefix_hit_rate"] = phits / max(phits + pmiss, 1.0)
+        sprop = r.sum_counter("serving_spec_proposed_total")
+        sacc = r.sum_counter("serving_spec_accepted_total")
+        if sprop:
+            # fraction of speculative proposals the target model
+            # accepted; inverted signal — a LOW rate means the
+            # engine burns proposer+verify work for baseline-or-
+            # worse token throughput
+            out["spec_accept_rate"] = sacc / max(sprop, 1.0)
         preempts = r.sum_counter("serving_preemptions_total")
         if preempts:
             # KV-page preemptions per submitted request: sustained

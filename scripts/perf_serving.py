@@ -115,7 +115,9 @@ def run_baseline(spec, variables, work, args):
         # the whole flush returns
         done = t_flush[i] - w["arrival"]
         lat_first.append(done)
-        lat_tok.append(done / w["n_new"])
+        # per-token latency divides by tokens actually committed: the
+        # baseline generator runs every request to the uniform new_hi
+        lat_tok.append(done / args.new_hi)
     return {"wall_s": wall, "lat_first": lat_first, "lat_tok": lat_tok,
             "raw_tokens": len(work) * args.new_hi}
 
@@ -165,7 +167,7 @@ def run_continuous(spec, variables, work, args, buckets):
         w = work[r["request_id"]]
         lat_first.append((r["t_first"] - t0) - w["arrival"])
         lat_tok.append(((r["t_finish"] - t0) - w["arrival"])
-                       / w["n_new"])
+                       / max(len(r["tokens"]), 1))
         toks[r["request_id"]] = r["tokens"]
     return {"wall_s": wall, "lat_first": lat_first, "lat_tok": lat_tok,
             "raw_tokens": sum(w["n_new"] for w in work),

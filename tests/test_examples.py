@@ -37,6 +37,7 @@ SMOKE_SCRIPTS = {
     "perf_regress.py": ["--smoke"],
     "perf_roofline.py": ["--smoke"],
     "perf_serving.py": ["--smoke"],
+    "perf_spec.py": ["--smoke"],
     "postmortem.py": ["--smoke"],
     "trace_merge.py": ["--smoke"],
 }
