@@ -1,13 +1,30 @@
-"""Flagship benchmark: ResNet-50 training throughput + MFU on one chip.
+"""Flagship benchmark: ResNet-50 training throughput + MFU.
 
-Prints ONE JSON line:
+Prints ONE JSON line in the BENCH trajectory ``parsed`` format:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 ``vs_baseline``/``mfu`` are null when the device kind has no known peak
 (fabricating a peak would fabricate the metric — ADVICE.md r1).
 
+Two arms (``--mode``):
+
+* ``sync`` — the historical single-chip synchronous train step
+  (metric ``resnet50_train_images_per_sec_per_chip``).
+* ``ps-mesh`` — the compiled SPMD PS round (``fidelity="mesh"``,
+  ISSUE 16): one worker per visible device, async ``MeshRoundDriver``
+  dispatch, optional on-chip comm compression
+  (``--comm-dtype``/``--comm-codec``).  Metric
+  ``ps_round_images_per_sec_per_chip`` — the same unit the flagship
+  script reports, so its records and BENCH records compare under
+  ``perf_regress`` (which keys candidates by metric name).
+* ``auto`` (default) — ``ps-mesh`` when more than one device is
+  visible, else ``sync``; the bench path IS the mesh tier wherever a
+  mesh exists.
+
 The reference published no machine-readable numbers (BASELINE.md:
-"published: {}"), so ``vs_baseline`` is measured MFU against the north-star
-target of 0.60 MFU from BASELINE.json (vs_baseline = MFU / 0.60).
+"published: {}"), so ``vs_baseline`` is measured MFU against the
+north-star target of 0.60 MFU from BASELINE.json (vs_baseline =
+MFU / 0.60) — identical semantics in both arms, with the mesh arm's
+MFU accounted as analytic model FLOPs x n_chips (``profiling.train_mfu``).
 
 MFU accounting (see PERF.md): ``mfu`` uses the *analytic model FLOPs* —
 2 x MACs x 3 for a training step (ResNet-50 fwd = 4.09 GMACs = 8.18
@@ -21,8 +38,10 @@ tracks useful work.  Both numbers are reported.
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -30,39 +49,39 @@ import numpy as np
 
 from distkeras_tpu import telemetry
 from distkeras_tpu.profiling import (
+    bench_device_config,
     peak_flops,
     resnet50_model_flops,
     time_step_chain,
+    train_mfu,
 )
 
 
-def main():
+def _model_and_step(cfg):
     from distkeras_tpu.models import ResNet50
-    from distkeras_tpu.workers import (TrainState, make_train_step,
-                                       resolve_optimizer)
-
-    trace_path = os.environ.get("DKT_TELEMETRY_TRACE")
-    if trace_path:
-        telemetry.enable()
-
-    device = jax.devices()[0]
-    on_tpu = device.platform != "cpu"
-    batch = 256 if on_tpu else 4
-    image = 224 if on_tpu else 64
-    num_classes = 1000 if on_tpu else 10
+    from distkeras_tpu.workers import make_train_step, resolve_optimizer
 
     # bf16 compute; space-to-depth stem re-layouts the 7x7/s2 stem conv
     # (same math/receptive field, different channel-summation order —
     # tests/test_models.py checks output parity to float tolerance via
     # s2d_stem_kernel) feeding the MXU 12 input channels instead of
     # 3 — measured ~1.5% faster end-to-end (PERF.md §9).
-    model = ResNet50(num_classes=num_classes, stem="space_to_depth")
+    model = ResNet50(num_classes=cfg["num_classes"],
+                     stem="space_to_depth")
     tx = resolve_optimizer("momentum", 0.1)
+    step = make_train_step(model, "categorical_crossentropy", tx)
+    return model, tx, step
+
+
+def run_sync(cfg) -> dict:
+    from distkeras_tpu.workers import TrainState
+
+    device, on_tpu = cfg["device"], cfg["on_tpu"]
+    batch, image = cfg["batch"], cfg["image"]
+    model, tx, step = _model_and_step(cfg)
     x = jnp.ones((batch, image, image, 3), jnp.float32)
     variables = model.init(jax.random.key(0), x[:2])
     state = TrainState.create(variables, tx, jax.random.key(1))
-
-    step = make_train_step(model, "categorical_crossentropy", tx)
     labels = jnp.zeros((batch,), jnp.int32)
     batch_dict = {"features": x, "label": labels}
 
@@ -72,6 +91,8 @@ def main():
     with telemetry.span("bench_compile", batch=batch):
         compiled = jit_step.lower(state, batch_dict).compile()
     cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+        cost = cost[0] if cost else {}
     xla_flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
 
     with telemetry.span("bench_timed_chain", n=30 if on_tpu else 3):
@@ -81,24 +102,129 @@ def main():
     images_per_sec = batch / dt
     model_flops_per_step = resnet50_model_flops(batch, image)
     peak, peak_known = peak_flops(device)
-    mfu = model_flops_per_step / dt / peak if peak_known else None
-    print(json.dumps({
+    mfu = train_mfu(images_per_sec, image, device)
+    return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(mfu / 0.60, 4) if peak_known else None,
-        "mfu": round(mfu, 4) if peak_known else None,
+        "vs_baseline": round(mfu / 0.60, 4) if mfu is not None else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
         "xla_mfu": (round(xla_flops_per_step / dt / peak, 4)
                     if peak_known else None),
         "step_time_ms": round(dt * 1e3, 2),
         "batch": batch,
         "image": image,
+        "n_chips": 1,
+        "mode": "sync",
         "model_flops_per_step": model_flops_per_step,
         "xla_flops_per_step": xla_flops_per_step,
         "device": getattr(device, "device_kind", str(device)),
         "peak_flops_known": peak_known,
         "metrics_finite": bool(np.isfinite(synced)),
-    }))
+    }
+
+
+def run_ps_mesh(cfg, comm_dtype: str, comm_codec,
+                window: int = 2) -> dict:
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.parallel import ps_dataplane
+    from distkeras_tpu.parallel.ps_emulator import commit_permutation
+    from distkeras_tpu.parallel.update_rules import RULES
+    from distkeras_tpu.workers import TrainState
+
+    device, on_tpu = cfg["device"], cfg["on_tpu"]
+    batch, image = cfg["batch"], cfg["image"]
+    W = cfg["n_devices"]
+    model, tx, step = _model_and_step(cfg)
+    x = jnp.ones((2, image, image, 3), jnp.float32)
+    center = model.init(jax.random.key(0), x)["params"]
+    rule = RULES["downpour"]()
+
+    placement = mesh_lib.place_workers(W)
+    dp = ps_dataplane.MeshDataplane(
+        rule, step, placement.mesh, center, comm_dtype=comm_dtype,
+        comm_codec=comm_codec)
+
+    def make_worker(rng):
+        return TrainState.create({"params": center}, tx, rng)
+
+    mps, mws = dp.to_device(
+        rule.init_state(center),
+        jax.vmap(make_worker)(jax.random.split(jax.random.key(1), W)))
+    row = mesh_lib.batch_sharding(placement.mesh)
+    rep = mesh_lib.replicated_sharding(placement.mesh)
+    batch_dict = jax.device_put(
+        {"features": jnp.ones((W, window, batch, image, image, 3),
+                              jnp.float32),
+         "label": jnp.zeros((W, window, batch), jnp.int32)}, row)
+    perm = jax.device_put(
+        commit_permutation(jax.random.key(2), W), rep)
+
+    driver = ps_dataplane.MeshRoundDriver(dp, mps, mws)
+    reps = 10 if on_tpu else 3
+    with telemetry.span("bench_mesh_warmup", workers=W):
+        driver.dispatch(batch_dict, perm)
+        driver.drain()
+    with telemetry.span("bench_mesh_timed_rounds", n=reps):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            driver.dispatch(batch_dict, perm)
+        metrics = driver.drain()  # blocks on the last round's ring
+        dt = (time.perf_counter() - t0) / reps
+
+    images_per_round = W * window * batch
+    images_per_sec_chip = images_per_round / dt / W
+    mfu = train_mfu(images_per_sec_chip * W, image, device, n_chips=W)
+    losses = np.concatenate([m["loss"] for m in metrics])
+    return {
+        "metric": "ps_round_images_per_sec_per_chip",
+        "value": round(images_per_sec_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.60, 4) if mfu is not None else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "round_ms": round(dt * 1e3, 2),
+        "step_time_ms": round(dt / window * 1e3, 2),
+        "batch": batch,
+        "image": image,
+        "workers": W,
+        "window": window,
+        "n_chips": W,
+        "mode": "ps-mesh",
+        "comm_dtype": comm_dtype,
+        "comm_codec": comm_codec,
+        "comm_bytes_per_round": dp.comm_bytes_per_round,
+        "comm_bytes_saved_per_round": dp.comm_bytes_saved_per_round,
+        "device": getattr(device, "device_kind", str(device)),
+        "peak_flops_known": mfu is not None,
+        "metrics_finite": bool(np.isfinite(losses).all()),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mode", default="auto",
+                        choices=("auto", "sync", "ps-mesh"),
+                        help="auto: ps-mesh when >1 device is visible")
+    parser.add_argument("--comm-dtype", default="float32",
+                        help="mesh arm delta wire dtype "
+                             "(float32|bfloat16)")
+    parser.add_argument("--comm-codec", default=None,
+                        help="mesh arm center broadcast codec (int8)")
+    args = parser.parse_args()
+
+    trace_path = os.environ.get("DKT_TELEMETRY_TRACE")
+    if trace_path:
+        telemetry.enable()
+
+    cfg = bench_device_config()
+    mode = args.mode
+    if mode == "auto":
+        mode = "ps-mesh" if cfg["n_devices"] > 1 else "sync"
+    if mode == "ps-mesh":
+        record = run_ps_mesh(cfg, args.comm_dtype, args.comm_codec)
+    else:
+        record = run_sync(cfg)
+    print(json.dumps(record))
     if trace_path:
         telemetry.tracer().write_chrome_trace(trace_path)
 

@@ -26,10 +26,47 @@ all-reduce / async reduce-scatter") actually describe:
   the center, so ``MeshWorkerState`` is ``TrainState`` minus
   ``params``.
 
-Partition specs for the worker state (optimizer moments, batch stats,
-rng streams) come from a small regex-rule → PartitionSpec-pytree
-resolver (``match_partition_rules``, the SNIPPETS [2] shape) layered
-on ``mesh.py``'s NamedShardings.
+Communication compression (ISSUE 16 tentpole) — two independent knobs,
+both lowered INSIDE the compiled round, mirroring the host wire codecs
+(``parallel.compression``) which remain the parity oracle:
+
+* ``comm_codec="int8"`` replaces the f32 center ``all_gather`` with an
+  int8 one: each device quantizes its own shard with PER-LEAF symmetric
+  scales computed on-device (partial per-leaf ``segment_max`` over the
+  local block, ``pmax`` across shards — the exact global ``max|x|``,
+  then ``scale = amax/127``, ``clip(round(x/scale))`` — the same law as
+  ``compression.Int8Codec``, float32 scale math instead of the host
+  codec's float64).  Dequantization is FUSED into the per-leaf unpack
+  (each leaf is sliced from the int8 buffer and multiplied by its
+  scalar scale), so no f32 intermediate of the full packed center ever
+  materializes — the program's only full-center transfer is 1 byte per
+  element plus one [n_leaves] scale vector.  The center shards
+  themselves stay exact f32; only the broadcast is lossy, and the
+  commit folds each worker's delta (computed against the center it
+  actually saw) into the exact shards.
+* ``comm_dtype="bfloat16"`` narrows the delta reduce-scatter: the
+  scaled f32 payload is cast to bf16 (the ``Bf16Codec`` law:
+  round-to-nearest-even) before ``psum_scatter`` and the reduction is
+  widened back into the f32 shard.  Unlike the host codec the
+  reduction itself runs in bf16 (the wire IS the reduction here), so
+  end-to-end tolerance is documented looser than the cast law.
+
+Both knobs apply to the float32 groups only; other dtypes ride
+uncompressed.  ``comm_bytes_per_round`` / ``comm_bytes_saved_per_round``
+expose the static per-round wire accounting (remote fraction of each
+collective, all devices), and every dispatched round increments
+``ps_round_comm_bytes_saved_total`` by the saving.
+
+Async host dispatch (tentpole 3): per-round metrics (loss / grad_norm /
+staleness, each ``[W]``) no longer return as a per-round dict — they
+accumulate into a device-resident ring of ``metrics_every`` rounds
+(``init_ring()``), written at a traced slot index so the slot never
+retraces.  ``MeshRoundDriver`` owns the dispatch loop: it enqueues
+round k+1 before fetching round k's metrics, fetches a completed ring
+only after at least one newer round is in flight
+(``ps_metrics_fetches_total`` counts the device reads), and its
+``sync=True`` mode is the eager-fetch oracle the async path is tested
+byte-identical against.
 
 Semantics are the ``fast`` tier's closed form, exactly: the center
 trajectory for DOWNPOUR/ADAG/DynSGD matches ``ps_emulator._fast_round``
@@ -42,14 +79,16 @@ its true depth (offset 0).  The elastic family commits absolute
 params against a serialized center — structurally not a reduction —
 and stays on the faithful/host tiers.
 
-Compile-guard telemetry: each distinct round shape traces exactly one
-program, counted by ``ps_round_compiles_total{fidelity="mesh"}``
-(``"mesh_pipelined"`` for the pipelined variant) — the same
-trace-time counter contract as the emulated tiers.
+Compile-guard telemetry: each distinct (round shape x comm config)
+traces exactly one program, counted by
+``ps_round_compiles_total{fidelity="mesh"}`` (``"mesh_pipelined"`` for
+the pipelined variant) — the same trace-time counter contract as the
+emulated tiers.
 """
 
 from __future__ import annotations
 
+import collections
 import math
 import re
 from typing import Any, Mapping, NamedTuple
@@ -70,6 +109,36 @@ from distkeras_tpu.parallel.update_rules import (
 from distkeras_tpu.workers import TrainState, make_window_runner
 
 Pytree = Any
+
+#: valid ``comm_dtype`` values (the delta reduce-scatter element type)
+COMM_DTYPES = ("float32", "bfloat16")
+#: valid ``comm_codec`` values (the center re-broadcast codec)
+COMM_CODECS = (None, "int8")
+
+
+# ---------------------------------------------------------------------------
+# On-chip codec law — jnp mirror of ``compression.Int8Codec`` /
+# ``Bf16Codec`` (the host parity oracles).
+# ---------------------------------------------------------------------------
+
+
+def quantize_int8(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization, the ``Int8Codec`` law on-device:
+    ``scale = max|x|/127`` (1.0 when all-zero), ``q = clip(round(
+    x/scale), -127, 127)``.  Scale math is float32 (the host codec
+    computes it in float64 — parity to rtol ~1e-6, documented in
+    ``tests/test_ps_dataplane.py``)."""
+    x = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x)) if x.size else jnp.float32(0.0)
+    scale = jnp.where(amax > 0, amax / jnp.float32(127.0),
+                      jnp.float32(1.0))
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jnp.ndarray, scale) -> jnp.ndarray:
+    """Inverse of ``quantize_int8`` (== ``Int8Codec.decode_leaf``)."""
+    return q.astype(jnp.float32) * jnp.float32(scale)
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +268,17 @@ class _FlatSpec:
                     self.shapes[i])
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
+    def seg_ids(self, name: str) -> np.ndarray:
+        """Static ``[padded]`` map position -> group-local leaf
+        ordinal; padding tail gets the extra id ``n_leaves`` so it
+        never pollutes a leaf's quantization scale."""
+        g = self.groups[name]
+        ids = np.full((g.padded,), len(g.indices), np.int32)
+        for j, i in enumerate(g.indices):
+            off = g.offsets[i]
+            ids[off:off + self.sizes[i]] = j
+        return ids
+
 
 # ---------------------------------------------------------------------------
 # States.
@@ -240,23 +320,28 @@ class MeshWorkerState(struct.PyTreeNode):
 class MeshDataplane:
     """One compiled SPMD program per PS round (see module docstring).
 
-    ``round``/``flush`` mirror the emulated signatures so the trainer
-    loop drives either tier unchanged:
+    Per-round metrics accumulate in a device-resident ring (see
+    ``init_ring``/``MeshRoundDriver``), so the signatures are:
 
-    * plain:     ``round(ps, ws, batch, perm) -> (ps, ws, metrics)``
+    * plain:     ``round(ps, ws, batch, perm, ring, slot)
+      -> (ps, ws, ring)``
     * pipelined: ``round(ps, ws, batch, perm, pending, pending_perm,
-      pending_valid) -> (ps, ws, metrics, pending, perm, valid)`` and
-      ``flush(ps, pending, pending_perm) -> ps``
+      pending_valid, ring, slot) -> (ps, ws, pending, perm, valid,
+      ring)`` and ``flush(ps, pending, pending_perm) -> ps``
 
-    with ``ps``/``ws`` in this module's sharded layout — convert a
-    host-layout ``(PSState, TrainState)`` pair with ``to_device`` once
-    before the first round, and read results back via ``center`` /
-    ``export_ps_state``.
+    ``slot`` is a traced replicated int32 scalar (``slot_index(i)``),
+    so cycling the ring never retraces.  ``ps``/``ws`` are donated;
+    the ring is NOT (old handles stay fetchable for the late metrics
+    read).  Convert a host-layout ``(PSState, TrainState)`` pair with
+    ``to_device`` once before the first round, and read results back
+    via ``center`` / ``export_ps_state``.
     """
 
     def __init__(self, rule: UpdateRule, step_fn, mesh,
                  center_template: Pytree, *, pipelined: bool = False,
-                 partition_rules=DEFAULT_WORKER_RULES):
+                 partition_rules=DEFAULT_WORKER_RULES,
+                 comm_dtype: str = "float32", comm_codec=None,
+                 metrics_every: int = 1):
         if rule.payload_kind != "delta":
             raise ValueError(
                 "fidelity='mesh' compiles the delta-family commit "
@@ -274,13 +359,36 @@ class MeshDataplane:
             raise ValueError(
                 "fidelity='mesh' is data-parallel only (one worker "
                 f"per device); mesh has extra axes {extra}")
+        if comm_dtype not in COMM_DTYPES:
+            raise ValueError(
+                f"unknown comm_dtype {comm_dtype!r}; valid: "
+                f"{list(COMM_DTYPES)}")
+        if comm_codec not in COMM_CODECS:
+            raise ValueError(
+                f"unknown comm_codec {comm_codec!r}; valid: "
+                f"{list(COMM_CODECS)}")
+        if int(metrics_every) < 1:
+            raise ValueError(
+                f"metrics_every must be >= 1, got {metrics_every}")
         self.rule = rule
         self.mesh = mesh
         self.num_workers = int(mesh.shape[mesh_lib.WORKER_AXIS])
         self.pipelined = bool(pipelined)
         self.partition_rules = tuple(partition_rules)
+        self.comm_dtype = str(comm_dtype)
+        self.comm_codec = comm_codec
+        self.metrics_every = int(metrics_every)
         self._window_run = make_window_runner(step_fn)
         self.spec = _FlatSpec(center_template, self.num_workers)
+        # compression applies to the float32 groups only — other
+        # dtypes (int counters, bool masks) ride uncompressed
+        self._quant_groups = frozenset(
+            n for n in self.spec.groups
+            if comm_codec == "int8" and jnp.dtype(n) == jnp.float32)
+        self._bf16_groups = frozenset(
+            n for n in self.spec.groups
+            if comm_dtype == "bfloat16" and jnp.dtype(n) == jnp.float32)
+        self._account_comm_bytes()
         self._rep = mesh_lib.replicated_sharding(mesh)
         self._row = mesh_lib.batch_sharding(mesh)
         self._block_shardings = {n: self._row for n in self.spec.groups}
@@ -290,7 +398,34 @@ class MeshDataplane:
             lambda mps: self.spec.unpack(
                 {n: b.reshape(-1) for n, b in mps.blocks.items()}),
             out_shardings=self._rep)
+        self._slot_cache: dict[int, jax.Array] = {}
         self._ws_specs = None  # resolved on first to_device
+
+    def _account_comm_bytes(self) -> None:
+        """Static per-round wire accounting.  Convention: the REMOTE
+        fraction each collective moves per device ((W-1)/W of the
+        padded buffer), summed over all W devices; the int8 arm adds
+        its per-leaf scale ``pmax`` side channel.  ``saved`` is vs the
+        all-f32 configuration of the same shapes."""
+        W = self.num_workers
+        gather = scatter = saved = 0
+        for n, g in self.spec.groups.items():
+            item = jnp.dtype(n).itemsize
+            remote = (g.padded - g.padded // W) * W
+            if n in self._quant_groups:
+                side = (len(g.indices) + 1) * 4 * W
+                gather += remote * 1 + side
+                saved += remote * (item - 1) - side
+            else:
+                gather += remote * item
+            if n in self._bf16_groups:
+                scatter += remote * 2
+                saved += remote * (item - 2)
+            else:
+                scatter += remote * item
+        self.comm_bytes_per_round = {"gather": int(gather),
+                                     "scatter": int(scatter)}
+        self.comm_bytes_saved_per_round = max(int(saved), 0)
 
     # -- state conversion ------------------------------------------------
 
@@ -332,6 +467,26 @@ class MeshDataplane:
                 jnp.zeros((self.num_workers, g.padded), dt), self._row)
         return out
 
+    def init_ring(self) -> dict[str, jnp.ndarray]:
+        """Zero device-resident metrics ring: ``metrics_every`` rounds
+        of per-worker ``[W]`` rows per metric.  NOT donated by
+        ``round``, so a saved handle from round k stays fetchable
+        while round k+1 runs — the async driver's late read."""
+        N, W = self.metrics_every, self.num_workers
+        ring = {"loss": jnp.zeros((N, W), jnp.float32),
+                "grad_norm": jnp.zeros((N, W), jnp.float32),
+                "staleness": jnp.zeros((N, W), jnp.int32)}
+        return jax.device_put(ring, self._rep)
+
+    def slot_index(self, i: int) -> jax.Array:
+        """Replicated traced int32 scalar for ring slot ``i`` (cached:
+        one device array per slot, so cycling never re-transfers)."""
+        i = int(i) % self.metrics_every
+        if i not in self._slot_cache:
+            self._slot_cache[i] = jax.device_put(
+                jnp.asarray(i, jnp.int32), self._rep)
+        return self._slot_cache[i]
+
     # -- program construction --------------------------------------------
 
     def _build_programs(self, template: MeshWorkerState) -> None:
@@ -358,6 +513,16 @@ class MeshDataplane:
         dyn = isinstance(rule, DynSGDRule)
         window_run = self._window_run
         row_blocks = {n: P(WA) for n in spec.groups}
+        quant = self._quant_groups
+        bf16 = self._bf16_groups
+
+        # static per-position leaf ids for the quantized groups, packed
+        # [W, block] like the center so each device reads its own row
+        self._seg_blocks = {
+            n: jax.device_put(
+                jnp.asarray(spec.seg_ids(n).reshape(W, -1)), self._row)
+            for n in sorted(quant)}
+        seg_specs = {n: P(WA) for n in self._seg_blocks}
 
         def _local(tree):
             return jax.tree_util.tree_map(lambda x: x[0], tree)
@@ -365,13 +530,49 @@ class MeshDataplane:
         def _stacked(tree):
             return jax.tree_util.tree_map(lambda x: x[None], tree)
 
-        def window_and_delta(blocks, ws, batch):
-            # Fused round-start pull: ONE all-gather of the center
-            # shards per device — the program's only full-center copy.
-            center_flat = {
-                n: jax.lax.all_gather(b[0], WA, tiled=True)
-                for n, b in blocks.items()}
-            center = spec.unpack(center_flat)
+        def pull_center(blocks, segs):
+            # Fused round-start pull: ONE all-gather per dtype group —
+            # the program's only full-center transfer.  Quantized
+            # groups gather int8 (per-leaf scales replicated by the
+            # pmax, never gathered) and dequantize FUSED into the
+            # per-leaf unpack below, so no full-width f32 packed
+            # buffer of the center ever materializes.
+            flats, scales = {}, {}
+            for n, b in blocks.items():
+                local = b[0]
+                if n in quant:
+                    g = spec.groups[n]
+                    nseg = len(g.indices)
+                    seg = segs[n][0]
+                    part = jax.ops.segment_max(
+                        jnp.abs(local), seg, num_segments=nseg + 1,
+                        indices_are_sorted=True)
+                    amax = jax.lax.pmax(part, WA)[:nseg]
+                    # the Int8Codec law (quantize_int8), per leaf
+                    scale = jnp.where(amax > 0,
+                                      amax / jnp.float32(127.0),
+                                      jnp.float32(1.0))
+                    spos = jnp.concatenate(
+                        [scale, jnp.ones((1,), jnp.float32)])[seg]
+                    q = jnp.clip(jnp.round(local / spos),
+                                 -127.0, 127.0).astype(jnp.int8)
+                    flats[n] = jax.lax.all_gather(q, WA, tiled=True)
+                    scales[n] = scale
+                else:
+                    flats[n] = jax.lax.all_gather(b[0], WA, tiled=True)
+            leaves: list = [None] * len(spec.shapes)
+            for n, g in spec.groups.items():
+                flat, sc = flats[n], scales.get(n)
+                for j, i in enumerate(g.indices):
+                    off = g.offsets[i]
+                    piece = flat[off:off + spec.sizes[i]]
+                    if sc is not None:
+                        piece = piece.astype(jnp.float32) * sc[j]
+                    leaves[i] = piece.reshape(spec.shapes[i])
+            return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+        def window_and_delta(blocks, segs, ws, batch):
+            center = pull_center(blocks, segs)
             state = TrainState(
                 step=ws.step[0], params=center,
                 opt_state=_local(ws.opt_state),
@@ -380,6 +581,9 @@ class MeshDataplane:
             window = jax.tree_util.tree_leaves(
                 local_batch)[0].shape[0]
             new_state, step_metrics = window_run(state, local_batch)
+            # delta vs the center this worker actually SAW (the
+            # dequantized pull under comm_codec) — commits fold into
+            # the exact shards, so the server never drifts lossily
             delta = rule.normalize_delta(
                 utils.tree_sub(new_state.params, center), window)
             new_ws = MeshWorkerState(
@@ -392,15 +596,22 @@ class MeshDataplane:
         def commit(blocks, flat, scale):
             # Per-device scaled payload -> reduce-scatter -> each
             # device folds the reduction into its own center shard.
+            # bf16 groups ride the wire (and reduce) narrowed — the
+            # Bf16Codec cast law; the shard itself stays f32.
             out = {}
             for n, b in blocks.items():
-                scaled = flat[n] * scale.astype(flat[n].dtype)
-                out[n] = b + jax.lax.psum_scatter(
-                    scaled, WA, tiled=True)[None]
+                payload = flat[n] * scale.astype(flat[n].dtype)
+                if n in bf16:
+                    red = jax.lax.psum_scatter(
+                        payload.astype(jnp.bfloat16), WA,
+                        tiled=True).astype(b.dtype)
+                else:
+                    red = jax.lax.psum_scatter(payload, WA, tiled=True)
+                out[n] = b + red[None]
             return out
 
-        def round_body(blocks, clock, ws, batch, inv):
-            flat, new_ws, sm = window_and_delta(blocks, ws, batch)
+        def round_body(blocks, segs, clock, ws, batch, inv):
+            flat, new_ws, sm = window_and_delta(blocks, segs, ws, batch)
             pos = inv[jax.lax.axis_index(WA)]
             scale = (1.0 / (pos.astype(jnp.float32) + 1.0) if dyn
                      else jnp.float32(1.0))
@@ -414,27 +625,33 @@ class MeshDataplane:
 
         round_smap = utils.shard_map(
             round_body, mesh=self.mesh,
-            in_specs=(row_blocks, P(), specs, P(WA), P()),
+            in_specs=(row_blocks, seg_specs, P(), specs, P(WA), P()),
             out_specs=(row_blocks, P(), specs, P(WA)))
 
-        def plain_round(mps, mws, batch, perm):
+        def write_ring(ring, slot, metrics):
+            return {k: ring[k].at[slot].set(
+                        metrics[k].astype(ring[k].dtype))
+                    for k in ring}
+
+        def plain_round(mps, mws, batch, perm, ring, slot):
             # Python side effect at TRACE time only — the public
-            # one-compile-per-round-shape guard (same contract as the
-            # emulated tiers' counter).
+            # one-compile-per-(round-shape x comm-config) guard (same
+            # contract as the emulated tiers' counter).
             telemetry.metrics().counter(
                 "ps_round_compiles_total", fidelity="mesh").inc()
             inv = jnp.argsort(perm)
             blocks, clock, ws, metrics = round_smap(
-                mps.blocks, mps.clock, mws, batch, inv)
+                mps.blocks, self._seg_blocks, mps.clock, mws, batch,
+                inv)
             return (MeshPSState(blocks=blocks, clock=clock), ws,
-                    metrics)
+                    write_ring(ring, slot, metrics))
 
-        def pipe_body(blocks, clock, ws, batch, inv, pending, pinv,
-                      pvalid):
+        def pipe_body(blocks, segs, clock, ws, batch, inv, pending,
+                      pinv, pvalid):
             # window k (on the pre-commit center) and the commit of
             # round k-1's pending are independent subgraphs — XLA
             # overlaps them, same contract as make_pipelined_round_fn.
-            flat, new_ws, sm = window_and_delta(blocks, ws, batch)
+            flat, new_ws, sm = window_and_delta(blocks, segs, ws, batch)
             pos = inv[jax.lax.axis_index(WA)]
             ppos = pinv[jax.lax.axis_index(WA)]
             pscale = (1.0 / (ppos.astype(jnp.float32) + W + 1.0)
@@ -455,23 +672,25 @@ class MeshDataplane:
 
         pipe_smap = utils.shard_map(
             pipe_body, mesh=self.mesh,
-            in_specs=(row_blocks, P(), specs, P(WA), P(),
+            in_specs=(row_blocks, seg_specs, P(), specs, P(WA), P(),
                       {n: P(WA) for n in spec.groups}, P(), P()),
             out_specs=(row_blocks, P(), specs, P(WA),
                        {n: P(WA) for n in spec.groups}, P()))
 
         def pipe_round(mps, mws, batch, perm, pending, pending_perm,
-                       pending_valid):
+                       pending_valid, ring, slot):
             telemetry.metrics().counter(
                 "ps_round_compiles_total",
                 fidelity="mesh_pipelined").inc()
             inv = jnp.argsort(perm)
             pinv = jnp.argsort(pending_perm)
             (blocks, clock, ws, metrics, new_pending,
-             valid) = pipe_smap(mps.blocks, mps.clock, mws, batch,
-                                inv, pending, pinv, pending_valid)
+             valid) = pipe_smap(mps.blocks, self._seg_blocks,
+                                mps.clock, mws, batch, inv, pending,
+                                pinv, pending_valid)
             return (MeshPSState(blocks=blocks, clock=clock), ws,
-                    metrics, new_pending, perm, valid)
+                    new_pending, perm, valid,
+                    write_ring(ring, slot, metrics))
 
         def flush_body(blocks, clock, pending, pinv):
             # drain at TRUE depth: no window ran ahead -> offset 0
@@ -495,7 +714,133 @@ class MeshDataplane:
             return MeshPSState(blocks=blocks, clock=clock)
 
         if self.pipelined:
-            self.round = jax.jit(pipe_round, donate_argnums=(0, 1, 4))
+            round_jit = jax.jit(pipe_round, donate_argnums=(0, 1, 4))
             self.flush = jax.jit(flush_fn, donate_argnums=(0, 1))
+            fid = "mesh_pipelined"
         else:
-            self.round = jax.jit(plain_round, donate_argnums=(0, 1))
+            round_jit = jax.jit(plain_round, donate_argnums=(0, 1))
+            fid = "mesh"
+        self._round_jit = round_jit
+        saved = self.comm_bytes_saved_per_round
+
+        def dispatch_round(*args):
+            # host-side wire accounting per dispatched round (static
+            # bytes, from the packed shapes) — ~200ns when telemetry
+            # is disabled, invisible next to the device round
+            if saved:
+                telemetry.metrics().counter(
+                    "ps_round_comm_bytes_saved_total",
+                    fidelity=fid).inc(saved)
+            return round_jit(*args)
+
+        self.round = dispatch_round
+
+
+# ---------------------------------------------------------------------------
+# Async host dispatch.
+# ---------------------------------------------------------------------------
+
+
+class MeshRoundDriver:
+    """Host loop for the mesh round: dispatch k+1 before fetching k.
+
+    Owns the dataplane state (``mps``/``mws``, plus the pipelined
+    variant's pending commit) and the metrics ring.  ``dispatch``
+    enqueues one round and NEVER blocks on device results; a completed
+    ring (every ``metrics_every`` rounds) is fetched only after at
+    least one newer round has been dispatched, so host control never
+    serializes the device.  ``metrics_every=1`` with async fetch
+    reproduces the trainer's historical one-round-late drain exactly.
+
+    ``sync=True`` fetches eagerly after every dispatch — the test
+    oracle the async path is asserted byte-identical against.
+
+    ``poll()`` returns per-round metric dicts (host numpy, ``[W]`` per
+    metric) that became available since the last call, in round order;
+    ``drain()`` additionally blocks on everything outstanding
+    (including a partially filled ring) and resets the ring cursor.
+    Each device read of a ring increments
+    ``ps_metrics_fetches_total``.
+    """
+
+    def __init__(self, dp: MeshDataplane, mps: MeshPSState,
+                 mws: MeshWorkerState, *, sync: bool = False):
+        self.dp = dp
+        self.mps = mps
+        self.mws = mws
+        self.sync = bool(sync)
+        self.ring = dp.init_ring()
+        self._slot = 0          # next ring slot to write
+        self._emitted = 0       # current-ring slots already emitted
+        self._queued: collections.deque = collections.deque()
+        self._ready: list[dict] = []
+        if dp.pipelined:
+            self.pending = dp.init_pending()
+            self.pending_perm = jax.device_put(
+                jnp.arange(dp.num_workers, dtype=jnp.int32), dp._rep)
+            self._false = jax.device_put(jnp.asarray(False), dp._rep)
+            self.pending_valid = self._false
+            self.pend_live = False
+
+    def dispatch(self, batch, perm) -> None:
+        """Enqueue one round; fetch only rings completed BEFORE this
+        dispatch (async) or everything so far (sync)."""
+        ready = list(self._queued)
+        self._queued.clear()
+        slot = self.dp.slot_index(self._slot)
+        if self.dp.pipelined:
+            (self.mps, self.mws, self.pending, self.pending_perm,
+             self.pending_valid, self.ring) = self.dp.round(
+                self.mps, self.mws, batch, perm, self.pending,
+                self.pending_perm, self.pending_valid, self.ring, slot)
+            self.pend_live = True
+        else:
+            self.mps, self.mws, self.ring = self.dp.round(
+                self.mps, self.mws, batch, perm, self.ring, slot)
+        self._slot += 1
+        if self.sync:
+            # eager oracle: read the just-written slot every round
+            self._emit(self.ring, self._emitted, self._slot)
+            self._emitted = self._slot
+            if self._slot == self.dp.metrics_every:
+                self._slot = self._emitted = 0
+        else:
+            if self._slot == self.dp.metrics_every:
+                self._queued.append((self.ring, self._slot))
+                self._slot = 0
+            for ring, count in ready:
+                self._emit(ring, 0, count)
+
+    def _emit(self, ring, start: int, stop: int) -> None:
+        telemetry.metrics().counter("ps_metrics_fetches_total").inc()
+        host = jax.device_get(ring)
+        for r in range(start, stop):
+            self._ready.append({k: v[r] for k, v in host.items()})
+
+    def poll(self) -> list[dict]:
+        """Metric dicts that became available since the last call."""
+        out, self._ready = self._ready, []
+        return out
+
+    def drain(self) -> list[dict]:
+        """Block on every outstanding metric (full + partial rings),
+        reset the ring cursor, and return them in round order."""
+        while self._queued:
+            ring, count = self._queued.popleft()
+            self._emit(ring, 0, count)
+        if self._slot > self._emitted:
+            self._emit(self.ring, self._emitted, self._slot)
+        self._slot = self._emitted = 0
+        return self.poll()
+
+    def flush_pipeline(self) -> None:
+        """Pipelined variant: fold the carried pending commit into the
+        center (epoch end / end of training) and re-arm a fresh inert
+        pending (the flushed buffers were donated)."""
+        if not self.dp.pipelined or not self.pend_live:
+            return
+        self.mps = self.dp.flush(self.mps, self.pending,
+                                 self.pending_perm)
+        self.pending = self.dp.init_pending()
+        self.pending_valid = self._false
+        self.pend_live = False

@@ -55,25 +55,30 @@ class LoweringTier:
     model_parallel: bool
     #: supports checkpoint/resume of mid-training state
     checkpoint: bool
+    #: lowers comm compression INSIDE the compiled round
+    #: (``comm_dtype``/``comm_codec``/``metrics_every`` kwargs); the
+    #: host arm's ``compression=`` wire codecs are a separate,
+    #: host-side feature gated on ``concurrent``
+    comm_compression: bool
 
 
 TIERS = {
     "host": LoweringTier(
         name="host", data_plane="host-wire", concurrent=True,
         deterministic=False, commit_overlap=True, model_parallel=False,
-        checkpoint=False),
+        checkpoint=False, comm_compression=False),
     "faithful": LoweringTier(
         name="faithful", data_plane="emulated", concurrent=False,
         deterministic=True, commit_overlap=True, model_parallel=True,
-        checkpoint=True),
+        checkpoint=True, comm_compression=False),
     "fast": LoweringTier(
         name="fast", data_plane="emulated", concurrent=False,
         deterministic=True, commit_overlap=False, model_parallel=True,
-        checkpoint=True),
+        checkpoint=True, comm_compression=False),
     "mesh": LoweringTier(
         name="mesh", data_plane="mesh", concurrent=False,
         deterministic=True, commit_overlap=True, model_parallel=False,
-        checkpoint=False),
+        checkpoint=False, comm_compression=True),
 }
 
 

@@ -57,6 +57,43 @@ def resnet50_model_flops(batch: int, image: int = 224,
             * (3 if train else 1))
 
 
+def bench_device_config() -> dict:
+    """One place for ``bench.py``'s device/shape assumptions (ISSUE 16
+    satellite — they were hardcoded inline, so the mesh arm would have
+    had to duplicate them).  ResNet-50 at the published shape on TPU;
+    a CPU run shrinks to a CI-sized problem rather than lying with an
+    un-runnable one.  ``n_devices`` is what ``--mode auto`` keys off.
+    """
+    devices = jax.devices()
+    device = devices[0]
+    on_tpu = device.platform != "cpu"
+    return {
+        "devices": devices,
+        "device": device,
+        "n_devices": len(devices),
+        "on_tpu": on_tpu,
+        "batch": 256 if on_tpu else 4,
+        "image": 224 if on_tpu else 64,
+        "num_classes": 1000 if on_tpu else 10,
+    }
+
+
+def train_mfu(images_per_sec: float, image: int, device,
+              n_chips: int = 1) -> float | None:
+    """Analytic-model-FLOPs MFU, honest across chip counts: total
+    images/sec x FLOPs per training image, over ``n_chips`` x peak.
+    Returns ``None`` when the device kind has no known peak (callers
+    must null the figure, not fabricate it — ADVICE.md r1).  Both
+    ``bench.py`` arms and the flagship script use THIS accounting, so
+    a mesh number and a single-chip number are directly comparable.
+    """
+    peak, known = peak_flops(device)
+    if not known:
+        return None
+    return (resnet50_model_flops(1, image) * images_per_sec
+            / (peak * n_chips))
+
+
 def host_sync(out) -> float:
     """Force full device execution by fetching one scalar to the host.
 

@@ -796,7 +796,10 @@ class DistributedTrainer(Trainer):
                  ps_shards: int = 1,
                  ps_elastic: bool = False,
                  ps_snapshot_path: str | None = None,
-                 ps_snapshot_every: int = 0, **kwargs):
+                 ps_snapshot_every: int = 0,
+                 comm_dtype: str = "float32",
+                 comm_codec=None,
+                 metrics_every: int = 1, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -899,7 +902,18 @@ class DistributedTrainer(Trainer):
         exchange for window *n* runs on a background thread while the
         device computes window *n+1* (the worker trains one exchange
         behind — +1 round of staleness, same trade as the emulated
-        pipelined round)."""
+        pipelined round).
+
+        ``comm_dtype='bfloat16'`` / ``comm_codec='int8'`` (mesh tier
+        only) lower communication compression INSIDE the compiled
+        round: bf16 deltas through the reduce-scatter, an int8
+        per-leaf-quantized center re-broadcast replacing the f32
+        all-gather (``parallel.ps_dataplane``; the host arm's
+        ``compression=`` codecs are the parity oracle).
+        ``metrics_every=N`` (mesh tier) accumulates per-round metrics
+        in a device-resident ring fetched every N rounds, and the
+        driver loop dispatches round k+1 before blocking on round k —
+        history contents are identical to the per-round fetch."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -971,6 +985,26 @@ class DistributedTrainer(Trainer):
                     "resharding stays byte-exact)")
         self.ps_snapshot_path = ps_snapshot_path
         self.ps_snapshot_every = int(ps_snapshot_every)
+        # on-chip comm knobs (mesh tier): lowered INSIDE the compiled
+        # round, unlike the host arm's `compression=` wire codecs
+        self.comm_dtype = str(comm_dtype)
+        self.comm_codec = comm_codec
+        self.metrics_every = int(metrics_every)
+        if self.metrics_every < 1:
+            raise ValueError(
+                f"metrics_every must be >= 1, got {metrics_every}")
+        if ((self.comm_dtype != "float32"
+             or self.comm_codec is not None
+             or self.metrics_every != 1)
+                and not self.tier.comm_compression):
+            raise ValueError(
+                "comm_dtype / comm_codec / metrics_every lower "
+                "communication compression and the metrics ring "
+                "INSIDE the compiled round; they apply only to tiers "
+                "with an on-chip data plane, got "
+                f"fidelity={fidelity!r}; on-chip tiers: "
+                f"{tiers_with('comm_compression')} (the host arm "
+                "compresses the wire via compression= instead)")
         if not self.tier.concurrent and (self.max_worker_failures
                                          or self.worker_retries
                                          or self.worker_timeout is not None
@@ -1251,7 +1285,10 @@ class DistributedTrainer(Trainer):
                 # the worker axis; states move into its packed layout
                 # here and stay on device (donated) between rounds.
                 dp = ps_dataplane.MeshDataplane(
-                    rule, step, m, center, pipelined=overlap)
+                    rule, step, m, center, pipelined=overlap,
+                    comm_dtype=self.comm_dtype,
+                    comm_codec=self.comm_codec,
+                    metrics_every=self.metrics_every)
                 ps_state, worker_states = dp.to_device(
                     ps_state, worker_states)
             elif mp > 1:
@@ -1286,9 +1323,12 @@ class DistributedTrainer(Trainer):
                     np.asarray(cursor.pop("perm_key_data"),
                                np.uint32)))
             if mesh_tier:
-                round_jit = dp.round
-                if overlap:
-                    flush_jit = dp.flush
+                # async host dispatch: the driver owns the dataplane
+                # state (and the pipelined pending), enqueues round
+                # k+1 before fetching round k's metrics, and drains
+                # the device-resident ring every metrics_every rounds
+                driver = ps_dataplane.MeshRoundDriver(
+                    dp, ps_state, worker_states)
             elif overlap:
                 round_jit = jax.jit(
                     round_fn,
@@ -1327,16 +1367,14 @@ class DistributedTrainer(Trainer):
         rows_per_worker_batch = self.batch_size
         cols = self._columns()
 
-        if overlap:
+        if overlap and not mesh_tier:
             # the pipelined round's carried pending commit: a zero
             # delta (inert for the delta family) until the first round
             # marks it valid; pend_live mirrors validity host-side so
             # the epoch-end flush doesn't fetch a device bool
-            if mesh_tier:
-                pend_payloads = dp.init_pending()
-            else:
-                pend_payloads = jax.tree_util.tree_map(
-                    jnp.zeros_like, worker_states.params)
+            # (the mesh tier's pending lives inside MeshRoundDriver)
+            pend_payloads = jax.tree_util.tree_map(
+                jnp.zeros_like, worker_states.params)
             if placement.mesh is not None:
                 pend_perm = mesh_lib.global_batch_from_local(
                     rep, np.arange(num_workers, dtype=np.int32))
@@ -1391,6 +1429,18 @@ class DistributedTrainer(Trainer):
                     round_loss=round_loss,
                     staleness=mesh_lib.fetch(
                         metrics_dev["staleness"]).tolist())
+
+            def sync_metrics():
+                # record everything outstanding, in round order: the
+                # mesh driver's ring (full + partial cycles) or the
+                # emulated tiers' one-round-late pending fetch
+                nonlocal pending
+                if mesh_tier:
+                    for fetched in driver.drain():
+                        drain(fetched)
+                elif pending is not None:
+                    drain(pending)
+                    pending = None
 
             # Rounds are numbered globally across segments (one segment
             # for in-memory datasets — identical behavior; one per
@@ -1483,8 +1533,7 @@ class DistributedTrainer(Trainer):
                         dropped_tail_batches=(n_batches
                                               - seg_rounds * window))
                 if due_save is not None and seg_rounds > 0:
-                    drain(pending)
-                    pending = None
+                    sync_metrics()
                     save_point({"epoch": epoch, "round": due_save})
                     due_save = None
                 for r_local in range(seg_rounds):
@@ -1513,7 +1562,14 @@ class DistributedTrainer(Trainer):
                     else:
                         batch = {k: jnp.asarray(v)
                                  for k, v in batch.items()}
-                    if overlap:
+                    if mesh_tier:
+                        # dispatch round k+1 before blocking on k:
+                        # poll() only surfaces rings fetched AFTER a
+                        # newer round was already in flight
+                        driver.dispatch(batch, perm)
+                        for fetched in driver.poll():
+                            drain(fetched)
+                    elif overlap:
                         (ps_state, worker_states, metrics,
                          pend_payloads, pend_perm, pend_valid) = \
                             round_jit(ps_state, worker_states, batch,
@@ -1523,9 +1579,10 @@ class DistributedTrainer(Trainer):
                     else:
                         ps_state, worker_states, metrics = round_jit(
                             ps_state, worker_states, batch, perm)
-                    if pending is not None:
-                        drain(pending)
-                    pending = metrics
+                    if not mesh_tier:
+                        if pending is not None:
+                            drain(pending)
+                        pending = metrics
                     # host-side round span (dispatch + previous-round
                     # drain; device time lives in profiler traces)
                     telemetry.complete("ps_round", t_round,
@@ -1533,8 +1590,7 @@ class DistributedTrainer(Trainer):
                     every = self.checkpoint_every_rounds
                     if every and (r + 1) % every == 0:
                         if r_local + 1 < seg_rounds:
-                            drain(pending)
-                            pending = None
+                            sync_metrics()
                             save_point({"epoch": epoch,
                                         "round": r + 1})
                         else:
@@ -1548,12 +1604,16 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     f"not enough batches per worker for one "
                     f"communication window ({window}) in any segment")
-            if pending is not None:
-                drain(pending)
-            if overlap and pend_live:
-                # the pipeline always runs one commit behind: fold the
-                # final pending round in so epoch-boundary eval (and
-                # the returned model) see every commit
+            sync_metrics()
+            if mesh_tier:
+                if overlap:
+                    # the pipeline always runs one commit behind: fold
+                    # the final pending round in so epoch-boundary eval
+                    # (and the returned model) see every commit
+                    driver.flush_pipeline()
+                ps_state, worker_states = driver.mps, driver.mws
+            elif overlap and pend_live:
+                # same flush for the emulated pipelined tiers
                 ps_state = flush_jit(ps_state, pend_payloads,
                                      pend_perm)
                 pend_valid = _false

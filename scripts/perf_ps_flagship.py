@@ -59,7 +59,8 @@ class _Arm:
     stable, see ``smoke()``)."""
 
     def __init__(self, args, fidelity: str, overlap: bool,
-                 mlp_dim: int | None = None):
+                 mlp_dim: int | None = None,
+                 sync_metrics: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -130,17 +131,17 @@ class _Arm:
             self._rep = mesh_lib.replicated_sharding(placement.mesh)
             self.dp = ps_dataplane.MeshDataplane(
                 self._rule, step, placement.mesh, center,
-                pipelined=overlap)
-            self.ps, self.ws = self.dp.to_device(ps, ws)
-            self.round_jit = self.dp.round
+                pipelined=overlap,
+                comm_dtype=getattr(args, "comm_dtype", "float32"),
+                comm_codec=getattr(args, "comm_codec", None),
+                metrics_every=getattr(args, "metrics_every", 1))
+            mps, mws = self.dp.to_device(ps, ws)
+            # async by default (the thing ISSUE 16 measures: round k+1
+            # dispatched before round k's metrics land); sync_metrics
+            # is the smoke's per-round parity mode
+            self.driver = ps_dataplane.MeshRoundDriver(
+                self.dp, mps, mws, sync=sync_metrics)
             self.n_chips = placement.mesh_workers
-            if overlap:
-                self.pend = self.dp.init_pending()
-                self.pend_perm = jax.device_put(
-                    jnp.arange(args.workers, dtype=jnp.int32),
-                    self._rep)
-                self.valid = jax.device_put(jnp.asarray(False),
-                                            self._rep)
         else:
             self.ps, self.ws = ps, ws
             if overlap:
@@ -167,6 +168,13 @@ class _Arm:
         return batch, perm
 
     def round(self, batch, perm):
+        """One round.  Mesh tier: dispatch through the driver and
+        return the latest fetched metrics (the just-run round's under
+        ``sync_metrics``; possibly ``None`` early in an async run)."""
+        if self.dp is not None:
+            self.driver.dispatch(batch, perm)
+            out = self.driver.poll()
+            return out[-1] if out else None
         if self.overlap:
             (self.ps, self.ws, metrics, self.pend, self.pend_perm,
              self.valid) = self.round_jit(
@@ -177,13 +185,28 @@ class _Arm:
                 self.ps, self.ws, batch, perm)
         return metrics
 
+    def sync(self, metrics) -> float:
+        """Block until every dispatched round has executed; return a
+        loss scalar for the finite-ness health check."""
+        import numpy as np
+
+        from distkeras_tpu.profiling import host_sync
+
+        if self.dp is not None:
+            out = self.driver.drain()
+            if out:
+                metrics = out[-1]
+            if metrics is None:
+                return float("nan")
+            return float(np.asarray(metrics["loss"]).reshape(-1)[0])
+        return host_sync(metrics["loss"])
+
     def flush(self):
         """Drain the pipelined arm's carried pending commit."""
         if not self.overlap:
             return
         if self.dp is not None:
-            self.ps = self.dp.flush(self.ps, self.pend,
-                                    self.pend_perm)
+            self.driver.flush_pipeline()
         else:
             from distkeras_tpu.parallel.ps_emulator import \
                 flush_pending
@@ -194,7 +217,7 @@ class _Arm:
     def center_host(self):
         import jax
 
-        c = (self.dp.center(self.ps) if self.dp is not None
+        c = (self.dp.center(self.driver.mps) if self.dp is not None
              else self.ps.center)
         return jax.device_get(c)
 
@@ -205,8 +228,7 @@ def measure(args, fidelity: str, overlap: bool) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from distkeras_tpu.profiling import (host_sync, peak_flops,
-                                         resnet50_model_flops)
+    from distkeras_tpu.profiling import peak_flops, resnet50_model_flops
 
     arm = _Arm(args, fidelity, overlap)
     x = jnp.ones((args.workers, args.window, args.batch,
@@ -217,11 +239,11 @@ def measure(args, fidelity: str, overlap: bool) -> dict:
 
     for _ in range(3):
         metrics = arm.round(batch, perm)
-    host_sync(metrics["loss"])
+    arm.sync(metrics)
     t0 = time.perf_counter()
     for _ in range(args.reps):
         metrics = arm.round(batch, perm)
-    val = host_sync(metrics["loss"])
+    val = arm.sync(metrics)
     dt = (time.perf_counter() - t0) / args.reps
 
     imgs = args.workers * args.window * args.batch
@@ -246,15 +268,22 @@ def measure(args, fidelity: str, overlap: bool) -> dict:
         unit = "images/sec"
     if overlap:
         name += "_overlap"
+    # self-describing like bench.py's records (ISSUE 16 satellite):
+    # step_time_ms/mfu/comm_dtype/n_chips ride along so a BENCH file
+    # holding this record needs no out-of-band context
     return {
         "metric": name, "value": value, "unit": unit,
         "fidelity": fidelity, "trainer": args.trainer,
         "mfu": mfu, "round_ms": round(dt * 1e3, 2),
+        "step_time_ms": round(dt * 1e3 / args.window, 2),
         "per_step_ms": round(dt * 1e3 / args.window, 2),
         "workers": args.workers, "window": args.window,
         "batch_per_worker": args.batch,
         "global_images_per_round": imgs, "image": args.image,
+        "n_chips": arm.n_chips,
         "chips": arm.n_chips,
+        "comm_dtype": getattr(args, "comm_dtype", "float32"),
+        "comm_codec": getattr(args, "comm_codec", None),
         "loss_finite": bool(np.isfinite(val)),
     }
 
@@ -309,7 +338,8 @@ def smoke(args) -> dict:
     for trainer in ("downpour", "dynsgd"):
         args.trainer = trainer
         ref = _Arm(args, "fast", False, mlp_dim=dim)
-        got = _Arm(args, "mesh", False, mlp_dim=dim)
+        got = _Arm(args, "mesh", False, mlp_dim=dim,
+                   sync_metrics=True)
         for b, p in zip(batches, perms):
             mr = ref.round(*ref.put(b, p))
             mg = got.round(*got.put(b, p))
@@ -318,7 +348,8 @@ def smoke(args) -> dict:
                      f"{trainer} center")
 
         refp = _Arm(args, "faithful", True, mlp_dim=dim)
-        gotp = _Arm(args, "mesh", True, mlp_dim=dim)
+        gotp = _Arm(args, "mesh", True, mlp_dim=dim,
+                    sync_metrics=True)
         for b, p in zip(batches, perms):
             refp.round(*refp.put(b, p))
             gotp.round(*gotp.put(b, p))
@@ -380,6 +411,14 @@ def main():
     ap.add_argument("--classes", type=int, default=1000)
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--comm-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="mesh tier: delta reduce-scatter wire dtype")
+    ap.add_argument("--comm-codec", default=None,
+                    choices=[None, "int8"],
+                    help="mesh tier: center re-broadcast codec")
+    ap.add_argument("--metrics-every", type=int, default=1,
+                    help="mesh tier: rounds per metrics-ring fetch")
     ap.add_argument("--overlap", action="store_true",
                     help="commit-pipelined round (delta family): the "
                          "commit of round k-1 rides in the same "

@@ -49,7 +49,16 @@ DEFAULT_BASELINES = str(REPO / "BENCH_*.json")
 
 def load_trajectories(pattern: str) -> dict[str, list[float]]:
     """metric name -> trajectory of values, oldest first, failed runs
-    (rc != 0) excluded."""
+    (rc != 0) excluded.
+
+    Keying by metric name is what keeps MIXED-metric BENCH files from
+    cross-comparing: a file whose run emitted the mesh-tier
+    ``ps_round_images_per_sec_per_chip`` record never lands in the
+    single-chip ``resnet50_train_*`` trajectory (ISSUE 16).  ``parsed``
+    may be one record or a LIST of records (a run that printed several
+    JSON lines, e.g. the flagship sweep) — each list entry joins its
+    own metric's trajectory at the same ``n``.
+    """
     out: dict[str, list[float]] = {}
     records = []
     for path in glob.glob(pattern):
@@ -60,7 +69,10 @@ def load_trajectories(pattern: str) -> dict[str, list[float]]:
         parsed = rec.get("parsed")
         if not parsed or rec.get("rc", 0) != 0:
             continue
-        records.append((rec.get("n", 0), parsed))
+        entries = parsed if isinstance(parsed, list) else [parsed]
+        for p in entries:
+            if isinstance(p, dict) and "metric" in p and "value" in p:
+                records.append((rec.get("n", 0), p))
     for _, parsed in sorted(records, key=lambda r: r[0]):
         out.setdefault(parsed["metric"], []).append(
             float(parsed["value"]))

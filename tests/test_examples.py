@@ -31,6 +31,7 @@ SMOKE_SCRIPTS = {
     "perf_elastic.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
+    "perf_mesh_comm.py": ["--smoke"],
     "perf_paging.py": ["--smoke"],
     "perf_prefix.py": ["--smoke"],
     "perf_ps_flagship.py": ["--smoke"],
