@@ -47,9 +47,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import attrib as attrib_lib
 from distkeras_tpu import telemetry
 from distkeras_tpu.profiling import (
     bench_device_config,
+    peak_bandwidth,
     peak_flops,
     resnet50_model_flops,
     time_step_chain,
@@ -89,7 +91,9 @@ def run_sync(cfg) -> dict:
     # telemetry consumer wiring: spans are no-ops unless the caller
     # enabled telemetry (DKT_TELEMETRY_TRACE dumps the timeline)
     with telemetry.span("bench_compile", batch=batch):
+        t_compile = time.perf_counter()
         compiled = jit_step.lower(state, batch_dict).compile()
+        compile_s = time.perf_counter() - t_compile
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):  # older jax: one dict per device
         cost = cost[0] if cost else {}
@@ -102,16 +106,28 @@ def run_sync(cfg) -> dict:
     images_per_sec = batch / dt
     model_flops_per_step = resnet50_model_flops(batch, image)
     peak, peak_known = peak_flops(device)
+    bw, bw_known = peak_bandwidth(device)
     mfu = train_mfu(images_per_sec, image, device)
+    # roofline floor for THIS compiled step: XLA's flops against peak
+    # compute, its bytes-accessed against peak memory bandwidth
+    bytes_accessed = (float(cost.get("bytes accessed", 0.0))
+                      if cost else 0.0)
+    roof = attrib_lib.roofline(xla_flops_per_step, bytes_accessed,
+                               peak, bw)
+    mfu_roofline = attrib_lib.mfu(xla_flops_per_step,
+                                  roof["t_roofline_s"], peak)
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(mfu / 0.60, 4) if mfu is not None else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_roofline": (round(mfu_roofline, 4)
+                         if mfu_roofline is not None else None),
         "xla_mfu": (round(xla_flops_per_step / dt / peak, 4)
-                    if peak_known else None),
+                    if peak == peak else None),
         "step_time_ms": round(dt * 1e3, 2),
+        "compile_s": round(compile_s, 3),
         "batch": batch,
         "image": image,
         "n_chips": 1,
@@ -119,7 +135,7 @@ def run_sync(cfg) -> dict:
         "model_flops_per_step": model_flops_per_step,
         "xla_flops_per_step": xla_flops_per_step,
         "device": getattr(device, "device_kind", str(device)),
-        "peak_flops_known": peak_known,
+        "peak_known": bool(peak_known and bw_known),
         "metrics_finite": bool(np.isfinite(synced)),
     }
 
@@ -172,6 +188,17 @@ def run_ps_mesh(cfg, comm_dtype: str, comm_codec,
         metrics = driver.drain()  # blocks on the last round's ring
         dt = (time.perf_counter() - t0) / reps
 
+    # attribution pass OUTSIDE the timed window: flip sampling on for
+    # one extra round to decompose it (host_gap/dispatch/compute/fetch
+    # + the mfu_observed-vs-roofline pair off the cost ledger)
+    driver.attrib_every = 1
+    with telemetry.span("bench_mesh_attrib", workers=W):
+        driver.dispatch(batch_dict, perm)
+        metrics += driver.drain()
+    attrib = driver.last_attrib or {}
+    report = dp.cost_report()
+    cost0 = report[0] if report else {}
+
     images_per_round = W * window * batch
     images_per_sec_chip = images_per_round / dt / W
     mfu = train_mfu(images_per_sec_chip * W, image, device, n_chips=W)
@@ -194,8 +221,18 @@ def run_ps_mesh(cfg, comm_dtype: str, comm_codec,
         "comm_codec": comm_codec,
         "comm_bytes_per_round": dp.comm_bytes_per_round,
         "comm_bytes_saved_per_round": dp.comm_bytes_saved_per_round,
+        "mfu_roofline": (round(attrib["mfu_roofline"], 4)
+                         if "mfu_roofline" in attrib else None),
+        "mfu_observed": (round(attrib["mfu_observed"], 4)
+                         if "mfu_observed" in attrib else None),
+        "attrib": {seg: round(attrib[seg] * 1e3, 3)
+                   for seg in ("host_gap", "dispatch",
+                               "device_compute", "ring_fetch")
+                   if seg in attrib},
+        "compile_s": (round(cost0["compile_s"], 3)
+                      if "compile_s" in cost0 else None),
         "device": getattr(device, "device_kind", str(device)),
-        "peak_flops_known": mfu is not None,
+        "peak_known": bool(cost0.get("peak_known", False)),
         "metrics_finite": bool(np.isfinite(losses).all()),
     }
 

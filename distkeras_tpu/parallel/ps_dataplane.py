@@ -91,6 +91,7 @@ from __future__ import annotations
 import collections
 import math
 import re
+import time
 from typing import Any, Mapping, NamedTuple
 
 import jax
@@ -400,6 +401,10 @@ class MeshDataplane:
             out_shardings=self._rep)
         self._slot_cache: dict[int, jax.Array] = {}
         self._ws_specs = None  # resolved on first to_device
+        # XLA cost ledger: batch-shape key -> (Compiled, record)
+        self._programs: dict[tuple, tuple] = {}
+        self._cost_records: list[dict] = []
+        self._last_record: dict | None = None
 
     def _account_comm_bytes(self) -> None:
         """Static per-round wire accounting.  Convention: the REMOTE
@@ -628,9 +633,17 @@ class MeshDataplane:
             in_specs=(row_blocks, seg_specs, P(), specs, P(WA), P()),
             out_specs=(row_blocks, P(), specs, P(WA)))
 
+        rep = self._rep
+
         def write_ring(ring, slot, metrics):
-            return {k: ring[k].at[slot].set(
-                        metrics[k].astype(ring[k].dtype))
+            # Pin the updated ring to the replicated sharding of
+            # ``init_ring`` — GSPMD would otherwise propagate the
+            # metric rows' worker sharding into the output, giving
+            # round k+1 a different input signature than round k and
+            # breaking the one-executable-per-shape AOT ledger.
+            return {k: jax.lax.with_sharding_constraint(
+                        ring[k].at[slot].set(
+                            metrics[k].astype(ring[k].dtype)), rep)
                     for k in ring}
 
         def plain_round(mps, mws, batch, perm, ring, slot):
@@ -721,7 +734,9 @@ class MeshDataplane:
             round_jit = jax.jit(plain_round, donate_argnums=(0, 1))
             fid = "mesh"
         self._round_jit = round_jit
+        self._round_fid = fid
         saved = self.comm_bytes_saved_per_round
+        programs = self._programs
 
         def dispatch_round(*args):
             # host-side wire accounting per dispatched round (static
@@ -731,9 +746,101 @@ class MeshDataplane:
                 telemetry.metrics().counter(
                     "ps_round_comm_bytes_saved_total",
                     fidelity=fid).inc(saved)
-            return round_jit(*args)
+            # AOT execution path: one explicit lower+compile per batch
+            # shape (args[2]; every other operand's shape is fixed per
+            # dataplane), so the cost ledger holds the Compiled handle
+            # for EVERY program that ever runs — same one-trace-per-
+            # shape contract the compile guard asserts, plus
+            # cost/memory analysis and compile time on the record.
+            key = tuple((tuple(x.shape), str(x.dtype))
+                        for x in jax.tree_util.tree_leaves(args[2]))
+            entry = programs.get(key)
+            if entry is None:
+                entry = self._compile_round(key, args)
+            self._last_record = entry[1]
+            return entry[0](*args)
 
         self.round = dispatch_round
+
+    def _compile_round(self, key, args):
+        """Ledger miss: AOT-compile the round for this batch shape and
+        record its XLA cost model (tentpole 1, ISSUE 17)."""
+        from distkeras_tpu import attrib as attrib_lib
+
+        fid = self._round_fid
+        t0 = time.perf_counter()
+        compiled = self._round_jit.lower(*args).compile()
+        compile_s = time.perf_counter() - t0
+        cost = attrib_lib.extract_cost(compiled)
+        rec = {
+            "program": fid,
+            "comm_dtype": self.comm_dtype,
+            "comm_codec": self.comm_codec,
+            "workers": self.num_workers,
+            "batch_shapes": key,
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+            "peak_temp_bytes": cost["peak_temp_bytes"],
+            "argument_bytes": cost["argument_bytes"],
+            "output_bytes": cost["output_bytes"],
+            "collective_bytes": dict(self.comm_bytes_per_round),
+            "comm_bytes_saved": self.comm_bytes_saved_per_round,
+            "compile_s": compile_s,
+        }
+        m = telemetry.metrics()
+        m.counter("ps_round_compile_seconds_total",
+                  fidelity=fid).inc(compile_s)
+        if cost["flops"] is not None:
+            m.gauge("ps_round_program_flops", fidelity=fid).set(
+                cost["flops"])
+        if cost["bytes_accessed"] is not None:
+            m.gauge("ps_round_program_bytes_accessed",
+                    fidelity=fid).set(cost["bytes_accessed"])
+        self._cost_records.append(rec)
+        entry = (compiled, rec)
+        self._programs[key] = entry
+        return entry
+
+    def last_program_record(self) -> dict | None:
+        """Ledger record of the most recently dispatched program (the
+        driver's sampled MFU pair reads per-device flops off it)."""
+        return self._last_record
+
+    def cost_report(self) -> list[dict]:
+        """The XLA cost ledger: one record per compiled round program
+        (per batch shape; a dataplane instance is already per comm
+        config), with the roofline prediction appended against the
+        local device's peak numbers.
+
+        Record schema: ``program`` (fidelity), ``comm_dtype`` /
+        ``comm_codec`` / ``workers`` / ``batch_shapes`` (config),
+        ``flops`` / ``bytes_accessed`` / ``peak_temp_bytes`` (XLA cost
+        + memory analysis, per device; ``None`` when the backend hides
+        them), ``collective_bytes`` / ``comm_bytes_saved`` (static wire
+        accounting), ``compile_s``, and ``roofline`` (``t_compute_s`` /
+        ``t_comm_s`` / ``t_roofline_s`` / ``bound`` /
+        ``arithmetic_intensity`` per :func:`attrib.roofline`) with the
+        ``peak_flops`` / ``peak_bytes_per_sec`` / ``peak_known`` terms
+        it was computed against.
+        """
+        from distkeras_tpu import attrib as attrib_lib
+        from distkeras_tpu import profiling
+
+        dev = jax.devices()[0]
+        peak, peak_known = profiling.peak_flops(dev)
+        bw, bw_known = profiling.peak_bandwidth(dev)
+        out = []
+        for rec in self._cost_records:
+            r = dict(rec)
+            per_dev_comm = (sum(rec["collective_bytes"].values())
+                            / max(rec["workers"], 1))
+            r["roofline"] = attrib_lib.roofline(
+                rec["flops"] or 0.0, per_dev_comm, peak, bw)
+            r["peak_flops"] = peak
+            r["peak_bytes_per_sec"] = bw
+            r["peak_known"] = bool(peak_known and bw_known)
+            out.append(r)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -761,17 +868,41 @@ class MeshRoundDriver:
     (including a partially filled ring) and resets the ring cursor.
     Each device read of a ring increments
     ``ps_metrics_fetches_total``.
+
+    ``attrib_every=N`` arms the sampled step-time decomposition (ISSUE
+    17 tentpole 2): every Nth dispatched round is split into host_gap /
+    dispatch / device_compute / ring_fetch segments
+    (``ps_round_attrib_seconds_total{segment}``) and pairs the
+    observed MFU against the ledger's roofline prediction
+    (``mfu_observed`` / ``mfu_roofline`` gauges; the latest sample is
+    also kept on ``last_attrib`` so bench records work with telemetry
+    off).  A sampled round serializes host on device — it is a
+    measurement, not the fast path — while non-sampled rounds pay only
+    the ``_attrib_tick`` guard plus one clock stamp, and
+    ``attrib_every=0`` (default) pays a single int test
+    (``attrib.attrib_overhead`` bounds both).  Sampling only ever adds
+    reads (an extra block + ring fetch), so the trained state is
+    byte-identical to an attrib-off run.
     """
 
     def __init__(self, dp: MeshDataplane, mps: MeshPSState,
-                 mws: MeshWorkerState, *, sync: bool = False):
+                 mws: MeshWorkerState, *, sync: bool = False,
+                 attrib_every: int = 0):
         self.dp = dp
         self.mps = mps
         self.mws = mws
         self.sync = bool(sync)
+        self.attrib_every = int(attrib_every)
+        if self.attrib_every < 0:
+            raise ValueError("attrib_every must be >= 0 (0 disables "
+                             "round attribution sampling)")
         self.ring = dp.init_ring()
         self._slot = 0          # next ring slot to write
         self._emitted = 0       # current-ring slots already emitted
+        self._round_index = 0   # total rounds dispatched (attrib clock)
+        self._last_end = None   # host-gap anchor: prior dispatch end
+        self._peaks = None      # cached (peak_flops, known) per driver
+        self.last_attrib: dict | None = None
         self._queued: collections.deque = collections.deque()
         self._ready: list[dict] = []
         if dp.pipelined:
@@ -782,9 +913,24 @@ class MeshRoundDriver:
             self.pending_valid = self._false
             self.pend_live = False
 
+    def _attrib_tick(self) -> bool:
+        """Fast-path sampling guard: is the round about to be
+        dispatched a sampled one?  ``attrib_every=0`` exits on one int
+        test; armed it adds one modulo — the whole disabled-path cost
+        ``attrib.attrib_overhead`` bounds (plus the end-of-dispatch
+        clock stamp when armed)."""
+        ae = self.attrib_every
+        if not ae:
+            return False
+        return self._round_index % ae == 0
+
     def dispatch(self, batch, perm) -> None:
         """Enqueue one round; fetch only rings completed BEFORE this
         dispatch (async) or everything so far (sync)."""
+        sampled = self._attrib_tick()
+        self._round_index += 1
+        if sampled:
+            t0 = time.perf_counter()
         ready = list(self._queued)
         self._queued.clear()
         slot = self.dp.slot_index(self._slot)
@@ -798,6 +944,10 @@ class MeshRoundDriver:
             self.mps, self.mws, self.ring = self.dp.round(
                 self.mps, self.mws, batch, perm, self.ring, slot)
         self._slot += 1
+        if sampled:
+            self._sample(t0)
+        elif self.attrib_every:
+            self._last_end = time.perf_counter()
         if self.sync:
             # eager oracle: read the just-written slot every round
             self._emit(self.ring, self._emitted, self._slot)
@@ -810,6 +960,68 @@ class MeshRoundDriver:
                 self._slot = 0
             for ring, count in ready:
                 self._emit(ring, 0, count)
+
+    def _sample(self, t0: float) -> None:
+        """Sampled-round decomposition: split the just-dispatched round
+        into segments, emit counters/gauges, stash ``last_attrib``.
+
+        Segments: ``host_gap`` (end of previous dispatch -> this
+        dispatch start: host-side work between rounds), ``dispatch``
+        (enqueue: program-cache hit + runtime dispatch), and — read off
+        the SAME in-flight round by serializing on it — ``device_compute``
+        (enqueue return -> outputs ready) and ``ring_fetch`` (device ->
+        host transfer of the metrics ring).  The extra block/fetch only
+        READS; the trained state is untouched.
+        """
+        t1 = time.perf_counter()
+        jax.block_until_ready((self.mps.blocks, self.ring))
+        t2 = time.perf_counter()
+        jax.device_get(self.ring)
+        t3 = time.perf_counter()
+        seg = {
+            "host_gap": (t0 - self._last_end
+                         if self._last_end is not None else 0.0),
+            "dispatch": t1 - t0,
+            "device_compute": t2 - t1,
+            "ring_fetch": t3 - t2,
+        }
+        m = telemetry.metrics()
+        for name, secs in seg.items():
+            m.counter("ps_round_attrib_seconds_total",
+                      segment=name).inc(secs)
+        attrib = dict(seg)
+        rec = self.dp.last_program_record()
+        if rec is not None and rec.get("flops"):
+            from distkeras_tpu import attrib as attrib_lib
+            from distkeras_tpu import profiling
+
+            if self._peaks is None:
+                dev = jax.devices()[0]
+                self._peaks = (profiling.peak_flops(dev),
+                               profiling.peak_bandwidth(dev))
+            (peak, peak_known), (bw, bw_known) = self._peaks
+            per_dev_comm = (sum(rec["collective_bytes"].values())
+                            / max(rec["workers"], 1))
+            roof = attrib_lib.roofline(rec["flops"], per_dev_comm,
+                                       peak, bw)
+            # observed round time = enqueue + device execution: on an
+            # async backend dispatch is ~0 so this IS device time; on
+            # the synchronous CPU backend the round runs inside the
+            # enqueue call and device_compute alone would be ~0
+            obs = attrib_lib.mfu(
+                rec["flops"],
+                seg["dispatch"] + seg["device_compute"], peak)
+            pred = attrib_lib.mfu(rec["flops"], roof["t_roofline_s"],
+                                  peak)
+            if obs is not None and pred is not None:
+                m.gauge("mfu_observed").set(obs)
+                m.gauge("mfu_roofline").set(pred)
+                attrib["mfu_observed"] = obs
+                attrib["mfu_roofline"] = pred
+                attrib["peak_known"] = bool(peak_known and bw_known)
+                attrib["roofline"] = roof
+        self.last_attrib = attrib
+        self._last_end = time.perf_counter()
 
     def _emit(self, ring, start: int, stop: int) -> None:
         telemetry.metrics().counter("ps_metrics_fetches_total").inc()

@@ -60,25 +60,30 @@ class LoweringTier:
     #: host arm's ``compression=`` wire codecs are a separate,
     #: host-side feature gated on ``concurrent``
     comm_compression: bool
+    #: supports sampled round attribution (the ``attrib_every`` kwarg:
+    #: ``MeshRoundDriver`` step-time decomposition + the XLA cost
+    #: ledger's mfu_observed/mfu_roofline pair) — requires the
+    #: AOT-compiled round programs only the mesh data plane has
+    round_attrib: bool
 
 
 TIERS = {
     "host": LoweringTier(
         name="host", data_plane="host-wire", concurrent=True,
         deterministic=False, commit_overlap=True, model_parallel=False,
-        checkpoint=False, comm_compression=False),
+        checkpoint=False, comm_compression=False, round_attrib=False),
     "faithful": LoweringTier(
         name="faithful", data_plane="emulated", concurrent=False,
         deterministic=True, commit_overlap=True, model_parallel=True,
-        checkpoint=True, comm_compression=False),
+        checkpoint=True, comm_compression=False, round_attrib=False),
     "fast": LoweringTier(
         name="fast", data_plane="emulated", concurrent=False,
         deterministic=True, commit_overlap=False, model_parallel=True,
-        checkpoint=True, comm_compression=False),
+        checkpoint=True, comm_compression=False, round_attrib=False),
     "mesh": LoweringTier(
         name="mesh", data_plane="mesh", concurrent=False,
         deterministic=True, commit_overlap=True, model_parallel=False,
-        checkpoint=False, comm_compression=True),
+        checkpoint=False, comm_compression=True, round_attrib=True),
 }
 
 

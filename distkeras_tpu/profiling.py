@@ -1,22 +1,31 @@
 """Profiling / benchmarking utilities (SURVEY.md §5 "honest
 observability": the reference records only wall-clock ``training_time``;
-the rebuild ships peak-FLOPs tables, MFU accounting, safe device-sync
-timing, and a ``jax.profiler`` trace hook).
+the rebuild ships peak-FLOPs and peak-bandwidth tables, MFU accounting,
+safe device-sync timing, and a ``jax.profiler`` trace hook that anchors
+the device timeline to the host span clock).
 
-Shared by ``bench.py`` and the ``scripts/perf_*.py`` experiments so the
-constants and the timing workaround live in exactly one place.
+Shared by ``bench.py``, ``distkeras_tpu.attrib`` and the
+``scripts/perf_*.py`` experiments so the constants and the timing
+workaround live in exactly one place.
 """
 
 from __future__ import annotations
 
 import contextlib
+import glob
+import json
+import os
 import time
 from typing import Iterator
 
 import jax
 import jax.numpy as jnp
 
-#: bf16 peak FLOP/s per chip by device kind (public spec sheets).
+#: bf16 peak FLOP/s per chip by device kind (public spec sheets).  The
+#: ``"cpu"`` row is a NOMINAL placeholder for CI runs off-TPU — it is
+#: deliberately reported as ``known=False`` by :func:`peak_flops` so an
+#: MFU computed against it carries an explicit ``peak_known: false``
+#: flag instead of looking authoritative.
 PEAK_FLOPS = {
     "TPU v2": 45e12,
     "TPU v3": 123e12,
@@ -27,7 +36,24 @@ PEAK_FLOPS = {
     "TPU v5p": 459e12,
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
-    "cpu": 1e12,  # nominal, for CI runs off-TPU
+    "cpu": 1e12,  # nominal, for CI runs off-TPU (known=False)
+}
+
+#: HBM bandwidth, bytes/s per chip (public spec sheets) — the
+#: denominator of the roofline's communication term.  On the CPU
+#: backend collectives are memcpys through host memory; the nominal row
+#: keeps the roofline computable there (flagged ``known=False``).
+PEAK_BYTES_PER_SEC = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 820e9,
+    "TPU v5e": 820e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+    "cpu": 50e9,  # nominal host-memory figure (known=False)
 }
 
 #: Analytic forward FLOPs (2 x MACs) per image for ResNet-50 @ 224px
@@ -35,18 +61,39 @@ PEAK_FLOPS = {
 #: §1 for why MFU uses this rather than XLA's executed-FLOPs counter.
 RESNET50_FWD_GFLOPS_224 = 8.18
 
+#: Device kinds whose table rows are nominal placeholders, not spec
+#: sheets.  A lookup that lands here still returns the value (so CI
+#: rooflines stay computable) but with ``known=False`` — callers must
+#: surface that flag (``peak_known`` in bench records) rather than let
+#: a guessed CPU peak masquerade as measured hardware.
+_NOMINAL_KINDS = frozenset({"cpu"})
+
+
+def _peak_lookup(table: dict, device) -> tuple[float, bool]:
+    kind = getattr(device, "device_kind", "cpu")
+    for key, val in table.items():
+        if kind.lower().startswith(key.lower()):
+            return val, key not in _NOMINAL_KINDS
+    return float("nan"), False
+
 
 def peak_flops(device) -> tuple[float, bool]:
     """(bf16 peak FLOP/s, known?) for ``device``.
 
-    Unknown device kinds return ``known=False``; callers must omit or
-    null their MFU figures rather than fabricate a peak (ADVICE.md r1).
+    Spec-sheet kinds return ``known=True``.  The CPU backend returns
+    its NOMINAL table value with ``known=False`` — usable for relative
+    CI gating, but callers must record the flag (``peak_known``)
+    instead of presenting the MFU as authoritative.  Unknown kinds
+    return ``(nan, False)``; callers must omit or null their MFU
+    figures rather than fabricate a peak (ADVICE.md r1).
     """
-    kind = getattr(device, "device_kind", "cpu")
-    for key, val in PEAK_FLOPS.items():
-        if kind.lower().startswith(key.lower()):
-            return val, True
-    return float("nan"), False
+    return _peak_lookup(PEAK_FLOPS, device)
+
+
+def peak_bandwidth(device) -> tuple[float, bool]:
+    """(peak bytes/s, known?) for ``device`` — same semantics as
+    :func:`peak_flops` (nominal CPU row, ``known=False``)."""
+    return _peak_lookup(PEAK_BYTES_PER_SEC, device)
 
 
 def resnet50_model_flops(batch: int, image: int = 224,
@@ -82,13 +129,16 @@ def train_mfu(images_per_sec: float, image: int, device,
               n_chips: int = 1) -> float | None:
     """Analytic-model-FLOPs MFU, honest across chip counts: total
     images/sec x FLOPs per training image, over ``n_chips`` x peak.
-    Returns ``None`` when the device kind has no known peak (callers
-    must null the figure, not fabricate it — ADVICE.md r1).  Both
-    ``bench.py`` arms and the flagship script use THIS accounting, so
-    a mesh number and a single-chip number are directly comparable.
+    Returns ``None`` when the device kind has no peak AT ALL (not even
+    a nominal row); a nominal-peak figure is returned but callers must
+    pair it with the ``known`` flag from :func:`peak_flops`
+    (``peak_known`` in bench records) so it cannot masquerade as a
+    measured-hardware number.  Both ``bench.py`` arms and the flagship
+    script use THIS accounting, so a mesh number and a single-chip
+    number are directly comparable.
     """
-    peak, known = peak_flops(device)
-    if not known:
+    peak, _known = peak_flops(device)
+    if peak != peak:  # NaN: no table row, nothing honest to divide by
         return None
     return (resnet50_model_flops(1, image) * images_per_sec
             / (peak * n_chips))
@@ -170,16 +220,46 @@ def telemetry_overhead(n: int = 200_000) -> dict:
     return out
 
 
+#: Filename of the wall-clock anchor :func:`profiler_trace` drops next
+#: to a device capture; ``telemetry.load_device_trace`` reads it to pin
+#: the trace's relative timestamps onto the host span timeline.
+WALL_ANCHOR_FILE = "wall_anchor.json"
+
+
 @contextlib.contextmanager
 def profiler_trace(log_dir: str | None) -> Iterator[None]:
     """``jax.profiler`` trace hook: no-op when ``log_dir`` is None, so
     trainers can accept an optional ``profile_dir`` flag without
-    branching at every call site."""
+    branching at every call site.
+
+    When active, writes ``wall_anchor.json`` (the wall clock at
+    ``start_trace``) into ``log_dir`` FIRST: XLA's ``trace.json.gz``
+    timestamps are microseconds RELATIVE to the capture start, and the
+    anchor is what lets ``telemetry.load_device_trace`` /
+    ``merge_traces`` shift them onto the host tracer's monotonic
+    timeline for one unified Perfetto file.
+    """
     if log_dir is None:
         yield
         return
+    os.makedirs(log_dir, exist_ok=True)
+    anchor = {"wall_s": time.time()}
+    with open(os.path.join(log_dir, WALL_ANCHOR_FILE), "w") as f:
+        json.dump(anchor, f)
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def find_device_traces(log_dir: str) -> list[str]:
+    """Chrome-format device traces under a :func:`profiler_trace` log
+    dir (``plugins/profile/<run>/<host>.trace.json.gz``), newest first.
+    Empty when the profiler produced nothing — callers skip cleanly.
+    """
+    pattern = os.path.join(log_dir, "**", "*.trace.json.gz")
+    hits = glob.glob(pattern, recursive=True)
+    hits += glob.glob(os.path.join(log_dir, "**", "*.trace.json"),
+                      recursive=True)
+    return sorted(set(hits), key=os.path.getmtime, reverse=True)

@@ -957,6 +957,47 @@ def merge_traces(*traces: Mapping | list) -> dict:
     return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
+def load_device_trace(path: str, wall_s: float | None = None) -> dict:
+    """Load an XLA device-profiler Chrome trace (the
+    ``*.trace.json.gz`` a ``jax.profiler`` capture writes) into a
+    ``merge_traces``-compatible dict.
+
+    Device timestamps are microseconds RELATIVE to ``start_trace``, not
+    a wall or monotonic clock, so alignment needs the wall time of the
+    capture start: ``profiling.profiler_trace`` drops it as
+    ``wall_anchor.json`` next to the capture, and this loader finds it
+    by walking up from ``path`` (or takes it explicitly via
+    ``wall_s``).  The synthesized ``wallAnchor`` sets ``mono_s=0.0`` —
+    the trace's own zero — so ``merge_traces``' shift formula lands
+    device events on the host tracer's monotonic timeline.  Without an
+    anchor the trace passes through unshifted (still mergeable, just
+    not aligned)."""
+    import gzip
+
+    p = os.fspath(path)
+    opener = gzip.open if p.endswith(".gz") else open
+    with opener(p, "rt") as f:
+        raw = json.load(f)
+    events = (raw.get("traceEvents", [])
+              if isinstance(raw, Mapping) else list(raw))
+    if wall_s is None:
+        probe = os.path.dirname(os.path.abspath(p))
+        for _ in range(8):
+            cand = os.path.join(probe, "wall_anchor.json")
+            if os.path.exists(cand):
+                with open(cand) as f:
+                    wall_s = json.load(f)["wall_s"]
+                break
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+    out: dict = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if wall_s is not None:
+        out["wallAnchor"] = {"wall_s": float(wall_s), "mono_s": 0.0}
+    return out
+
+
 def enable(ring_capacity: int = 65536,
            telemetry: Telemetry | None = None) -> Telemetry:
     """Install (and return) the global ``Telemetry``.  Idempotent-ish:
@@ -1003,6 +1044,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
     "preemption_rate": (0.25, 2.0),       # preemptions per request
     "spec_accept_rate": (0.20, 0.05),     # accepted / proposed tokens
+    "mfu_gap": (0.5, 0.9),                # 1 - observed/roofline MFU
 }
 
 #: Signals where LOW is bad: the comparison inverts (breach at/below
@@ -1040,7 +1082,8 @@ class SLOWatchdog:
     The signals (PS staleness p99, client retry rate, serving shed
     rate, queue depth, TTFT p95, idle-worker fraction, gateway
     failover rate, prefix hit rate, PS standby replication lag,
-    KV-page preemption rate, speculative accept rate) are computed
+    KV-page preemption rate, speculative accept rate, mesh-round MFU
+    gap) are computed
     from the registry's live metrics and compared against ``(degraded_at, critical_at)``
     thresholds — inverted for ``LOWER_IS_WORSE_SLO_SIGNALS``, where a
     LOW value breaches; the worst breach decides
@@ -1165,6 +1208,19 @@ class SLOWatchdog:
             # offered load (requests still finish — swap/recompute
             # readmission hides the churn, at a latency cost)
             out["preemption_rate"] = preempts / max(reqs, 1.0)
+        obs = r.collect("mfu_observed")
+        roof = r.collect("mfu_roofline")
+        if obs and roof:
+            # fraction of the roofline-predicted round throughput the
+            # measured round is LEAVING on the table (1 - obs/roof,
+            # from the driver's sampled attribution gauges).  The
+            # inversion is baked into the gap itself, so thresholds
+            # read the standard way: a HIGH gap is the breach — the
+            # round loop regressed against its own cost model.
+            o = obs[-1][1].value
+            f = roof[-1][1].value
+            if f > 0:
+                out["mfu_gap"] = min(max(1.0 - o / f, 0.0), 1.0)
         lag = r.collect("ps_standby_lag")
         if lag:
             # how many commit-log entries the slowest PS standby is

@@ -799,7 +799,8 @@ class DistributedTrainer(Trainer):
                  ps_snapshot_every: int = 0,
                  comm_dtype: str = "float32",
                  comm_codec=None,
-                 metrics_every: int = 1, **kwargs):
+                 metrics_every: int = 1,
+                 attrib_every: int = 0, **kwargs):
         """Elastic recovery (``fidelity='host'`` — the arm with real
         concurrency, hence real failures; the emulated arms recover via
         checkpoint/resume instead): a failing worker round is retried
@@ -913,7 +914,13 @@ class DistributedTrainer(Trainer):
         ``metrics_every=N`` (mesh tier) accumulates per-round metrics
         in a device-resident ring fetched every N rounds, and the
         driver loop dispatches round k+1 before blocking on round k —
-        history contents are identical to the per-round fetch."""
+        history contents are identical to the per-round fetch.
+        ``attrib_every=N`` (mesh tier) samples every Nth round into the
+        step-time decomposition (dispatch / device-compute / ring-fetch
+        / host-gap segments, ``ps_round_attrib_seconds_total``) and the
+        ``mfu_observed``/``mfu_roofline`` gauge pair from the XLA cost
+        ledger; 0 (default) disables sampling and trained state is
+        byte-identical either way."""
         super().__init__(model, **kwargs)
         self.num_workers = int(num_workers)
         self.communication_window = int(communication_window)
@@ -1005,6 +1012,18 @@ class DistributedTrainer(Trainer):
                 f"fidelity={fidelity!r}; on-chip tiers: "
                 f"{tiers_with('comm_compression')} (the host arm "
                 "compresses the wire via compression= instead)")
+        self.attrib_every = int(attrib_every)
+        if self.attrib_every < 0:
+            raise ValueError(
+                f"attrib_every must be >= 0 (0 disables round "
+                f"attribution sampling), got {attrib_every}")
+        if self.attrib_every and not self.tier.round_attrib:
+            raise ValueError(
+                "attrib_every samples the compiled round's step-time "
+                "decomposition off the mesh driver's AOT cost ledger; "
+                f"it applies only to tiers with round attribution, got "
+                f"fidelity={fidelity!r}; attribution tiers: "
+                f"{tiers_with('round_attrib')}")
         if not self.tier.concurrent and (self.max_worker_failures
                                          or self.worker_retries
                                          or self.worker_timeout is not None
@@ -1328,7 +1347,8 @@ class DistributedTrainer(Trainer):
                 # k+1 before fetching round k's metrics, and drains
                 # the device-resident ring every metrics_every rounds
                 driver = ps_dataplane.MeshRoundDriver(
-                    dp, ps_state, worker_states)
+                    dp, ps_state, worker_states,
+                    attrib_every=self.attrib_every)
             elif overlap:
                 round_jit = jax.jit(
                     round_fn,

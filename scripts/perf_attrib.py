@@ -1,0 +1,368 @@
+"""Mesh-round attribution: measured vs roofline, per comm arm (ISSUE 17).
+
+Runs the three comm arms of the compiled PS round (f32 / bf16 / int8 —
+the same arms as ``perf_mesh_comm``) on a deliberately comm-heavy MLP
+and prints the ATTRIBUTION TABLE: the measured round decomposed into
+host_gap / dispatch / device_compute / ring_fetch segments
+(``MeshRoundDriver`` sampled timing) next to the XLA cost ledger's
+roofline prediction (compute vs comm bound, from
+``MeshDataplane.cost_report()`` against ``profiling.peak_flops`` /
+``peak_bandwidth``), plus compile time and how many rounds amortize it.
+
+The run asserts the LEDGER INVARIANTS (static wire accounting, no
+timing noise):
+
+* int8 center gather = 1/4 of the f32 gather plus the per-leaf scale
+  side channel (the MLP center is all-f32, so the law is exact);
+* bf16 delta scatter = 1/2 of the f32 scatter;
+* both cross-checked against the live
+  ``ps_round_comm_bytes_saved_total`` counter: after R dispatched
+  rounds the counter equals R x (f32 bytes - compressed bytes);
+* attrib-on training is BYTE-IDENTICAL to attrib-off (sampling only
+  reads); and the disabled-path guard stays within the PERF.md no-op
+  budget (``attrib.attrib_overhead``).
+
+Headline gating (``perf_regress``, both directions — pass + forced
+breach): ``mesh_round_mfu_observed`` and the
+``mesh_round_mfu_of_roofline`` ratio (observed/roofline — the
+BENCH-trajectory form of the ``mfu_gap`` SLO signal), so a regressed
+round loop breaches the gate even when absolute throughput noise would
+hide it.
+
+Run:  python scripts/perf_attrib.py [--devices 4] [--dim 2048]
+          [--reps 3] [--out CAND.json] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+SCRIPTS = pathlib.Path(__file__).resolve().parent
+if str(SCRIPTS) not in sys.path:
+    sys.path.insert(0, str(SCRIPTS))
+
+ARMS = (("f32", "float32", None),
+        ("bf16", "bfloat16", None),
+        ("int8", "float32", "int8"))
+
+SEGMENTS = ("host_gap", "dispatch", "device_compute", "ring_fetch")
+
+
+def _build(args, comm_dtype, comm_codec, attrib_every=0):
+    """One comm arm's dataplane + driver + seeded inputs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distkeras_tpu import mesh as mesh_lib
+    from distkeras_tpu.models import build_model, model_config
+    from distkeras_tpu.parallel import ps_dataplane
+    from distkeras_tpu.parallel.ps_emulator import commit_permutation
+    from distkeras_tpu.parallel.update_rules import RULES
+    from distkeras_tpu.workers import (TrainState, make_train_step,
+                                       resolve_optimizer)
+
+    W = args.workers
+    model = build_model(model_config(
+        "mlp", (args.dim,), num_classes=args.classes,
+        hidden=(args.dim,)))
+    tx = resolve_optimizer("momentum", args.lr)
+    center = model.init(jax.random.key(0),
+                        jnp.ones((2, args.dim), jnp.float32))["params"]
+    rule = RULES["downpour"]()
+    step = make_train_step(model, "sparse_categorical_crossentropy",
+                           tx)
+
+    placement = mesh_lib.place_workers(W)
+    if placement.mesh is None or placement.vmap_workers != 1:
+        raise SystemExit(
+            f"needs one device per worker; {W} workers vs "
+            f"{len(jax.devices())} devices (pass --devices N on CPU)")
+    dp = ps_dataplane.MeshDataplane(
+        rule, step, placement.mesh, center, comm_dtype=comm_dtype,
+        comm_codec=comm_codec)
+
+    def make_worker(rng):
+        return TrainState.create({"params": center}, tx, rng)
+
+    mps, mws = dp.to_device(
+        rule.init_state(center),
+        jax.vmap(make_worker)(jax.random.split(jax.random.key(1), W)))
+    row = mesh_lib.batch_sharding(placement.mesh)
+    rep = mesh_lib.replicated_sharding(placement.mesh)
+    rng = np.random.RandomState(0)
+    batches = [jax.device_put(
+        {"features": jnp.asarray(
+            rng.randn(W, args.window, args.batch, args.dim),
+            jnp.float32),
+         "label": jnp.asarray(
+            rng.randint(0, args.classes,
+                        (W, args.window, args.batch)), jnp.int32)},
+        row) for _ in range(3)]
+    perm = jax.device_put(commit_permutation(jax.random.key(2), W),
+                          rep)
+    driver = ps_dataplane.MeshRoundDriver(dp, mps, mws,
+                                          attrib_every=attrib_every)
+    return dp, driver, batches, perm
+
+
+def _measure_arm(args, comm_dtype, comm_codec):
+    """Warm, time ``--reps`` rounds attrib-OFF, then decompose one
+    sampled round; return (record, dp)."""
+    import numpy as np
+
+    dp, driver, batches, perm = _build(args, comm_dtype, comm_codec)
+    batch = batches[0]
+    driver.dispatch(batch, perm)
+    driver.drain()  # warm: AOT compile (into the ledger) + first run
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        driver.dispatch(batch, perm)
+    metrics = driver.drain()
+    dt = (time.perf_counter() - t0) / args.reps
+
+    # attribution pass OUTSIDE the timed window: a sampled round
+    # serializes host on device by design
+    driver.attrib_every = 1
+    driver.dispatch(batch, perm)
+    metrics += driver.drain()
+    attrib = driver.last_attrib or {}
+
+    report = dp.cost_report()
+    cost = report[0] if report else {}
+    roof = cost.get("roofline", {})
+    losses = np.concatenate([m["loss"] for m in metrics])
+    rec = {
+        "comm_dtype": comm_dtype, "comm_codec": comm_codec,
+        "round_ms": round(dt * 1e3, 3),
+        "attrib": {seg: round(attrib.get(seg, 0.0) * 1e3, 3)
+                   for seg in SEGMENTS},
+        "mfu_observed": attrib.get("mfu_observed"),
+        "mfu_roofline": attrib.get("mfu_roofline"),
+        "peak_known": bool(cost.get("peak_known", False)),
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes_accessed"),
+        "peak_temp_bytes": cost.get("peak_temp_bytes"),
+        "roofline_compute_ms": round(
+            roof.get("t_compute_s", 0.0) * 1e3, 3),
+        "roofline_comm_ms": round(roof.get("t_comm_s", 0.0) * 1e3, 3),
+        "roofline_ms": round(roof.get("t_roofline_s", 0.0) * 1e3, 3),
+        "bound": roof.get("bound"),
+        "compile_s": round(cost.get("compile_s", 0.0), 3),
+        "amortize_rounds": (round(cost.get("compile_s", 0.0) / dt, 1)
+                            if dt > 0 else None),
+        "comm_bytes_per_round": dp.comm_bytes_per_round,
+        "comm_bytes_saved_per_round": dp.comm_bytes_saved_per_round,
+        "rounds_dispatched": args.reps + 2,
+        "loss_finite": bool(np.isfinite(losses).all()),
+        "workers": args.workers,
+    }
+    return rec, dp
+
+
+def _train_center(args, attrib_every, rounds=3):
+    """Short f32 training run; returns the final center (host)."""
+    import jax
+
+    dp, driver, batches, perm = _build(args, "float32", None,
+                                       attrib_every=attrib_every)
+    for r in range(rounds):
+        driver.dispatch(batches[r % len(batches)], perm)
+    driver.drain()
+    return jax.device_get(dp.center(driver.mps))
+
+
+def _assert_byte_identity(args):
+    """Acceptance: attrib-on training is bitwise attrib-off (sampling
+    only READS device state)."""
+    import jax
+    import numpy as np
+
+    off = _train_center(args, attrib_every=0)
+    on = _train_center(args, attrib_every=2)
+    for la, lb in zip(jax.tree_util.tree_leaves(off),
+                      jax.tree_util.tree_leaves(on)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), \
+            "attrib sampling perturbed the trained center"
+    print(json.dumps({"byte_identity": "ok", "rounds": 3,
+                      "attrib_every": 2}), flush=True)
+
+
+def _assert_ledger_invariants(results, snap, args):
+    """Static wire laws + the live saved-bytes counter cross-check."""
+    W = args.workers
+    f32 = results["f32"]["comm_bytes_per_round"]
+    bf16 = results["bf16"]["comm_bytes_per_round"]
+    int8 = results["int8"]["comm_bytes_per_round"]
+
+    # the MLP center is all-f32, so the compression laws are exact:
+    # int8 gather = f32/4 + the (n_leaves+1) x 4B x W scale side
+    # channel; bf16 scatter = f32/2
+    n_leaves = results["f32"]["f32_leaves"]
+    side = (n_leaves + 1) * 4 * W
+    assert int8["gather"] - side == f32["gather"] // 4, \
+        (int8, f32, side)
+    assert bf16["scatter"] == f32["scatter"] // 2, (bf16, f32)
+    # saved-vs-f32 is exactly the collective-byte delta
+    saved_int8 = results["int8"]["comm_bytes_saved_per_round"]
+    saved_bf16 = results["bf16"]["comm_bytes_saved_per_round"]
+    assert saved_int8 == f32["gather"] - int8["gather"], \
+        (saved_int8, f32, int8)
+    assert saved_bf16 == f32["scatter"] - bf16["scatter"], \
+        (saved_bf16, f32, bf16)
+    assert results["f32"]["comm_bytes_saved_per_round"] == 0
+
+    # live counter: every dispatched compressed round accounted its
+    # static savings — R rounds x (bf16 + int8 savings)
+    counter = snap["counters"].get(
+        'ps_round_comm_bytes_saved_total{fidelity="mesh"}', 0)
+    rounds = results["bf16"]["rounds_dispatched"]
+    want = rounds * (saved_bf16 + saved_int8)
+    assert counter == want, (counter, want)
+    print(json.dumps({
+        "ledger_invariants": "ok",
+        "int8_gather_quarter": True, "bf16_scatter_half": True,
+        "saved_counter": counter,
+        "saved_per_round": {"bf16": saved_bf16, "int8": saved_int8},
+    }), flush=True)
+
+
+def _print_table(results):
+    cols = ("arm", "round_ms", "gap", "disp", "comp", "fetch",
+            "roof_comp", "roof_comm", "roof_ms", "bound",
+            "mfu_obs", "mfu_roof", "compile_s", "amort")
+    rows = [cols]
+    for name, r in results.items():
+        a = r["attrib"]
+        fmt = lambda v: ("-" if v is None else
+                         f"{v:.4g}" if isinstance(v, float) else str(v))
+        rows.append(tuple(fmt(v) for v in (
+            name, r["round_ms"], a["host_gap"], a["dispatch"],
+            a["device_compute"], a["ring_fetch"],
+            r["roofline_compute_ms"], r["roofline_comm_ms"],
+            r["roofline_ms"], r["bound"], r["mfu_observed"],
+            r["mfu_roofline"], r["compile_s"], r["amortize_rounds"])))
+    widths = [max(len(row[i]) for row in rows)
+              for i in range(len(cols))]
+    print("measured vs roofline (ms per round; mfu vs "
+          "peak{, peak_known=%s}):"
+          % results["f32"]["peak_known"], flush=True)
+    for row in rows:
+        print("  " + "  ".join(c.rjust(w)
+                               for c, w in zip(row, widths)),
+              flush=True)
+
+
+def run(args) -> list[dict]:
+    import jax
+
+    from distkeras_tpu import attrib as attrib_lib
+    from distkeras_tpu import telemetry
+
+    tel = telemetry.enable()
+    results = {}
+    for name, dtype, codec in ARMS:
+        rec, dp = _measure_arm(args, dtype, codec)
+        if name == "f32":
+            rec["f32_leaves"] = len(
+                dp.spec.groups["float32"].indices)
+        results[name] = rec
+        print(json.dumps({"arm": name, **rec}), flush=True)
+    snap = tel.metrics.snapshot()
+    telemetry.disable()
+
+    _print_table(results)
+    _assert_ledger_invariants(results, snap, args)
+    _assert_byte_identity(args)
+
+    # disabled-path guard stays inside the PERF.md no-op budget (the
+    # bound is generous vs the measured ~10-60ns so CI load can't
+    # flake it; the PERF row quotes the measured figure)
+    guard = attrib_lib.attrib_overhead(
+        n=20_000 if args.smoke else 200_000)
+    assert guard["disabled_ns"] < 1_000, guard
+    print(json.dumps({"attrib_overhead": guard}), flush=True)
+
+    # ---- perf_regress gating, both directions ------------------------
+    import perf_regress
+
+    obs = results["f32"]["mfu_observed"]
+    roof = results["f32"]["mfu_roofline"]
+    assert obs is not None and roof is not None and roof > 0, results
+    cands = [
+        {"metric": "mesh_round_mfu_observed", "value": round(obs, 6),
+         "unit": "mfu", "peak_known": results["f32"]["peak_known"]},
+        {"metric": "mesh_round_mfu_of_roofline",
+         "value": round(obs / roof, 6), "unit": "frac",
+         "peak_known": results["f32"]["peak_known"]},
+    ]
+    out_dir = pathlib.Path(tempfile.mkdtemp(prefix="dkt_attrib_"))
+    for n in (1, 2):
+        (out_dir / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "cmd": "perf_attrib", "rc": 0, "tail": "",
+            "parsed": cands}))
+    traj = perf_regress.load_trajectories(str(out_dir / "BENCH_*.json"))
+    rows = perf_regress.evaluate(cands, traj, tolerance=0.5)
+    print(perf_regress.render(rows), flush=True)
+    assert all(r["status"] == "pass" for r in rows), rows
+    bad = perf_regress.evaluate(
+        [{"metric": c["metric"], "value": c["value"] / 10.0}
+         for c in cands], traj, tolerance=0.5)
+    assert all(r["status"] == "breach" for r in bad), bad
+    print(json.dumps({"gate": "pass_and_breach", "ok": True}),
+          flush=True)
+
+    records = cands + [
+        {"metric": f"mesh_attrib_{name}_round_ms",
+         "value": rec["round_ms"], "unit": "ms",
+         "lower_is_better": True, **rec}
+        for name, rec in results.items()]
+    if args.out:
+        pathlib.Path(args.out).write_text(json.dumps(records))
+    if args.smoke:
+        print(json.dumps({"smoke": "ok"}))
+    return records
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--window", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=2048,
+                    help="MLP width (comm-heavy regime, as in "
+                         "perf_mesh_comm)")
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force N virtual host devices (CPU runs)")
+    ap.add_argument("--out", default=None,
+                    help="write the parsed-format records (a LIST) "
+                         "for perf_regress.py --candidate")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes; tier-1 mode")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.devices = args.devices or 4
+        args.workers, args.window, args.batch = 4, 1, 4
+        args.dim, args.classes, args.reps = 64, 8, 2
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}")
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -249,11 +249,23 @@ def measure(args, fidelity: str, overlap: bool) -> dict:
     imgs = args.workers * args.window * args.batch
     peak, known = peak_flops(jax.devices()[0])
     # analytic MFU only where the model IS ResNet-50 (--smoke shrinks
-    # the stages, so its FLOP formula would be fiction)
+    # the stages, so its FLOP formula would be fiction); peak_known
+    # rides the record so a nominal CPU peak can't pass as measured
     mfu = None
-    if known and not args.smoke:
+    if peak == peak and not args.smoke:
         flops = resnet50_model_flops(imgs, args.image)
         mfu = round(flops / dt / (peak * arm.n_chips), 4)
+
+    # mesh tier: one attribution round outside the timed window (the
+    # sampled decomposition + the ledger's roofline pair, ISSUE 17)
+    attrib, cost0 = {}, {}
+    if fidelity == "mesh":
+        arm.driver.attrib_every = 1
+        arm.round(batch, perm)
+        arm.sync(None)
+        attrib = arm.driver.last_attrib or {}
+        report = arm.dp.cost_report()
+        cost0 = report[0] if report else {}
 
     if fidelity == "mesh":
         name = "ps_round_images_per_sec_per_chip"
@@ -284,6 +296,17 @@ def measure(args, fidelity: str, overlap: bool) -> dict:
         "chips": arm.n_chips,
         "comm_dtype": getattr(args, "comm_dtype", "float32"),
         "comm_codec": getattr(args, "comm_codec", None),
+        "mfu_roofline": (round(attrib["mfu_roofline"], 4)
+                         if "mfu_roofline" in attrib else None),
+        "mfu_observed": (round(attrib["mfu_observed"], 4)
+                         if "mfu_observed" in attrib else None),
+        "attrib": {seg: round(attrib[seg] * 1e3, 3)
+                   for seg in ("host_gap", "dispatch",
+                               "device_compute", "ring_fetch")
+                   if seg in attrib},
+        "compile_s": (round(cost0["compile_s"], 3)
+                      if "compile_s" in cost0 else None),
+        "peak_known": bool(cost0.get("peak_known", known)),
         "loss_finite": bool(np.isfinite(val)),
     }
 

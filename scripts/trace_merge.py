@@ -125,6 +125,74 @@ def serve(trace_out: str) -> None:
     print(f"COMMITS {ps.num_commits}", flush=True)
 
 
+# ---- smoke: device-trace alignment (ISSUE 17) --------------------------
+
+def device_alignment_case(out_dir: str) -> None:
+    """Unified host+device timeline: capture a ``jax.profiler`` device
+    trace around a host tracer span, load it via
+    ``telemetry.load_device_trace`` (wall anchor from
+    ``profiling.profiler_trace``), merge with the host dump, and assert
+    the device events land inside the host capture span's wall window.
+    Skips cleanly when the profiler can't capture on this backend."""
+    from distkeras_tpu import profiling, telemetry
+
+    log_dir = pathlib.Path(out_dir) / "device_profile"
+    host_path = pathlib.Path(out_dir) / "trace-host.json"
+    telemetry.enable()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        with profiling.profiler_trace(str(log_dir)):
+            with telemetry.span("device_capture"):
+                f = jax.jit(lambda x: (x @ x.T).sum())
+                f(jnp.ones((256, 256), jnp.float32)).block_until_ready()
+    except Exception as e:  # profiler backend unavailable here
+        telemetry.disable()
+        print("device-trace alignment: skipped "
+              f"({type(e).__name__}: {e})")
+        return
+    telemetry.tracer().write_chrome_trace(host_path)
+    telemetry.disable()
+
+    device_paths = profiling.find_device_traces(str(log_dir))
+    if not device_paths:
+        print("device-trace alignment: skipped "
+              "(profiler produced no device trace)")
+        return
+    device = telemetry.load_device_trace(device_paths[0])
+    assert "wallAnchor" in device, \
+        "profiler_trace wall anchor not found next to the capture"
+    # tag device events so they stay identifiable post-merge
+    for e in device["traceEvents"]:
+        if isinstance(e, dict):
+            e["cat"] = "device:" + str(e.get("cat", ""))
+    host = json.load(open(host_path))
+    merged = telemetry.merge_traces(host, device)  # host anchor = base
+    pathlib.Path(out_dir, "merged-device.json").write_text(
+        json.dumps(merged))
+
+    events = merged["traceEvents"]
+    caps = [e for e in events if e.get("ph") == "X"
+            and e["name"] == "device_capture"]
+    assert caps, "host capture span missing from merged timeline"
+    dev_ts = [e["ts"] for e in events
+              if str(e.get("cat", "")).startswith("device:")
+              and "ts" in e]
+    assert dev_ts, "no device events survived the merge"
+    # device events happened INSIDE the host capture span; allow
+    # generous slack for profiler start/stop bookkeeping outside it
+    lo = caps[0]["ts"] - 5e6
+    hi = caps[0]["ts"] + caps[0].get("dur", 0.0) + 5e6
+    mid = (min(dev_ts) + max(dev_ts)) / 2.0
+    assert lo <= mid <= hi, (
+        f"device events not aligned with the host capture window: "
+        f"device mid ts {mid} outside [{lo}, {hi}]")
+    print(f"device-trace alignment: {len(dev_ts)} device events "
+          f"aligned into the host capture window "
+          f"({device_paths[0].rsplit('/', 1)[-1]})")
+
+
 # ---- smoke: the parent (trainer) process -------------------------------
 
 def smoke(out_dir: str) -> None:
@@ -189,6 +257,7 @@ def smoke(out_dir: str) -> None:
         assert e["args"]["link_span"] in client_spans, e
     print(f"paired flow arrows: {paired}; "
           f"linked ps_rpc handler spans: {len(rpc)}")
+    device_alignment_case(out_dir)
     print("smoke: ok")
 
 

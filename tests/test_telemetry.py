@@ -530,6 +530,32 @@ def test_prefix_hit_rate_slo_signal_breaches_low(tel):
             "prefix_hit_rate": (0.2, 0.5)})
 
 
+def test_mfu_gap_slo_signal():
+    """ISSUE 17: ``mfu_gap`` = 1 - observed/roofline off the driver's
+    attribution gauges — a big gap (round running far below its
+    roofline floor) degrades the verdict."""
+    reg = telemetry.MetricsRegistry()
+    w = telemetry.SLOWatchdog(reg)
+    assert "mfu_gap" not in w.evaluate()["signals"]  # gauges absent
+    obs = reg.gauge("mfu_observed")
+    roof = reg.gauge("mfu_roofline")
+    obs.set(0.40)
+    roof.set(0.50)  # gap 0.2 < degraded_at 0.5: healthy
+    v = w.evaluate()
+    assert v["signals"]["mfu_gap"] == pytest.approx(0.2)
+    assert "mfu_gap" not in v["breaches"]
+    obs.set(0.20)  # gap 0.6 >= 0.5: degraded
+    v = w.evaluate()
+    assert v["breaches"]["mfu_gap"]["level"] == "degraded"
+    obs.set(0.02)  # gap 0.96 >= critical_at 0.9
+    v = w.evaluate()
+    assert v["breaches"]["mfu_gap"]["level"] == "critical"
+    obs.set(0.60)  # observed ABOVE the roofline estimate: clamped to 0
+    assert w.evaluate()["signals"]["mfu_gap"] == 0.0
+    roof.set(0.0)  # degenerate roofline: signal absent, not fabricated
+    assert "mfu_gap" not in w.evaluate()["signals"]
+
+
 # ---- trace context + wire header --------------------------------------
 
 def test_trace_context_nesting_and_wire_header(tel):
