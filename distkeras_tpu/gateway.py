@@ -903,6 +903,8 @@ class ServingGateway:
             spec["request_id"] = rid
             req = _GwRequest(rid, spec)
             self._requests[rid] = req
+            telemetry.metrics().gauge("gateway_inflight_requests").set(
+                len(self._requests))
         self._dispatch(req)
         return rid
 
@@ -916,7 +918,26 @@ class ServingGateway:
         res = req.future.wait(timeout)
         with self._lock:
             self._requests.pop(request_id, None)
+            telemetry.metrics().gauge("gateway_inflight_requests").set(
+                len(self._requests))
         return res
+
+    def try_result(self, request_id):
+        """Non-blocking ``result``: the result dict when ready (and
+        consumed), else ``None`` with the request left in flight.  The
+        traffic simulator's pacing loop polls this between arrivals —
+        it must never block behind one slow request while the offered
+        load keeps its own clock."""
+        with self._lock:
+            req = self._requests.get(request_id)
+            if req is None:
+                raise KeyError(f"unknown request_id {request_id!r}")
+            if not req.future.ready():
+                return None
+            self._requests.pop(request_id, None)
+            telemetry.metrics().gauge("gateway_inflight_requests").set(
+                len(self._requests))
+        return req.future.wait(0)
 
     def run(self, requests: Iterable, *, ordered: bool = True
             ) -> Iterator[dict]:
@@ -1095,6 +1116,21 @@ class ServingGateway:
                 "attempts": req.attempts}
 
     # -- health -------------------------------------------------------
+
+    def busy(self) -> bool:
+        """True while any replica swap (``rolling_update`` /
+        ``add_replica`` warm / ``remove_replica`` drain) is mid-flight
+        — the ``Autoscaler(busy=gw.busy)`` guard, so scaling verbs
+        never interleave with a live swap."""
+        with self._lock:
+            return bool(self._updating)
+
+    def alive_replicas(self) -> int:
+        """Routable capacity right now: replicas that are alive (a
+        mid-update replica still counts — it comes back).  This is the
+        ``Autoscaler(replica_count=...)`` hook and the drill's
+        convergence observable."""
+        return sum(1 for r in self._replicas if r.alive)
 
     def healthz(self) -> dict:
         """Aggregated verdict + per-replica verdicts.  ``critical``

@@ -105,6 +105,28 @@ class ChaosTransport:
         PS and gateway replicas attacks ONE hop: e.g.
         ``target_ports={replica_port}`` chaoses the gateway→replica
         wire while the training exchange stays clean.
+      windows: WALL-CLOCK fault phases beside the op-counter schedule:
+        ``[(t_start, t_end, kinds)]`` with times in seconds on the
+        injector's clock and ``kinds`` a subset of ``KINDS``.  While
+        ``t_start <= t < t_end`` every transport op additionally draws
+        a window fault: ``"partition"`` in ``kinds`` refuses every
+        ``connect`` in the window deterministically (no rng); the other
+        kinds fire with total probability ``window_rate`` per op, split
+        evenly among the window's drawable kinds.  Window draws come
+        from a SEPARATE rng stream seeded ``[seed, 7]``, so the base
+        op-counter schedule is bit-identical with or without windows —
+        the schedule stays a pure function of (seed, op index, clock
+        readings).  Window ``reset``/``truncate`` fires share the
+        ``max_injections`` budget.  This is the phase-aligned knob the
+        traffic simulator uses: a ``ChaosSchedule`` hands in a sim-time
+        clock so "faults during the flash crowd" is literally a window
+        over the load curve.
+      window_rate: per-op probability that an active window injects one
+        of its non-partition kinds (default 0.25).
+      clock: zero-arg callable returning seconds for window matching
+        (``None``: wall seconds since ``install()``).  Inject a
+        deterministic counter to make window decisions — not just the
+        base schedule — a pure function of the constructor arguments.
     """
 
     def __init__(self, seed: int = 0, *, reset_rate: float = 0.0,
@@ -116,13 +138,24 @@ class ChaosTransport:
                  partition_ports: Optional[set] = None,
                  max_injections: Optional[int] = None,
                  skip_ops: int = 0,
-                 target_ports: Optional[set] = None):
+                 target_ports: Optional[set] = None,
+                 windows=(),
+                 window_rate: float = 0.25,
+                 clock=None):
         for name, rate in (("reset_rate", reset_rate),
                            ("truncate_rate", truncate_rate),
-                           ("delay_rate", delay_rate)):
+                           ("delay_rate", delay_rate),
+                           ("window_rate", window_rate)):
             if not 0.0 <= rate <= 1.0:
                 raise ValueError(f"{name}={rate} outside [0, 1]")
+        self.windows = _validate_windows(windows)
+        self.window_rate = float(window_rate)
+        self._clock = clock
+        self._t0 = None  # wall anchor when no clock is injected
         self._rng = np.random.default_rng(seed)
+        # window decisions draw from their own stream so adding (or
+        # widening) windows never perturbs the base op-counter schedule
+        self._wrng = np.random.default_rng([seed, 7])
         self._rates = {"reset": float(reset_rate),
                        "truncate": float(truncate_rate),
                        "delay": float(delay_rate)}
@@ -152,13 +185,17 @@ class ChaosTransport:
 
     # -- schedule ----------------------------------------------------------
 
-    def _note(self, kind: str) -> None:
+    def _note(self, kind: str, window: bool = False) -> None:
         self.counts[kind] += 1
         telemetry.metrics().counter("chaos_injected_total",
                                     kind=kind).inc()
+        if window:
+            telemetry.metrics().counter("chaos_window_injected_total",
+                                        kind=kind).inc()
         # called under self._lock, so op index matches the draw that
         # scheduled this injection
-        flight_recorder.record("chaos", fault=kind, op=self._op)
+        flight_recorder.record("chaos", fault=kind, op=self._op,
+                               window=window)
 
     def _draw(self, op_kind: str, port: Optional[int] = None):
         """One scheduled decision; returns the fault to inject (or
@@ -166,44 +203,109 @@ class ChaosTransport:
         rng stream — are globally ordered.  ``port`` is the operation's
         peer port (None when unknowable, e.g. an already-dead socket):
         with ``target_ports`` set, a non-targeted op still consumes its
-        rng draw but never fires."""
+        rng draw but never fires.  The base op-counter decision is made
+        first; only when it declines does an active wall-clock window
+        get its (separately-streamed) draw."""
         with self._lock:
             op = self._op
             self._op += 1
             # the rng is consumed on EVERY op, injectable or not, so
             # the schedule is a pure function of (seed, op index)
             u = float(self._rng.random())
-            if op < self.skip_ops:
-                return None
-            targeted = (self.target_ports is None
-                        or (port is not None
-                            and port in self.target_ports))
-            part_targeted = (targeted
-                             and (self.partition_ports is None
-                                  or (port is not None
-                                      and port
-                                      in self.partition_ports)))
-            if (part_targeted and op_kind == "connect"
-                    and self._in_partition_window(op)):
-                self._note("partition")
-                return "partition"
-            budget_left = (self.max_injections is None
-                           or self._injected < self.max_injections)
-            edge = 0.0
-            for kind in ("reset", "truncate", "delay"):
-                edge += self._rates[kind]
-                if u < edge:
-                    if kind == "truncate" and op_kind != "send":
-                        return None  # only sends can truncate
-                    if not targeted:
-                        return None  # drawn, but this hop is off-limits
-                    if kind in ("reset", "truncate"):
-                        if not budget_left:
-                            return None
-                        self._injected += 1
-                    self._note(kind)
-                    return kind
+            fault = self._base_decision(op, u, op_kind, port)
+            if fault is not None or not self.windows:
+                return fault
+            return self._window_decision(op_kind, port)
+
+    def _base_decision(self, op: int, u: float, op_kind: str,
+                       port: Optional[int]):
+        # guarded-by: _lock (via _draw)
+        if op < self.skip_ops:
             return None
+        targeted = (self.target_ports is None
+                    or (port is not None
+                        and port in self.target_ports))
+        part_targeted = (targeted
+                         and (self.partition_ports is None
+                              or (port is not None
+                                  and port
+                                  in self.partition_ports)))
+        if (part_targeted and op_kind == "connect"
+                and self._in_partition_window(op)):
+            self._note("partition")
+            return "partition"
+        budget_left = (self.max_injections is None
+                       or self._injected < self.max_injections)
+        edge = 0.0
+        for kind in ("reset", "truncate", "delay"):
+            edge += self._rates[kind]
+            if u < edge:
+                if kind == "truncate" and op_kind != "send":
+                    return None  # only sends can truncate
+                if not targeted:
+                    return None  # drawn, but this hop is off-limits
+                if kind in ("reset", "truncate"):
+                    if not budget_left:
+                        return None
+                    # lint: allow(guarded-write) — under _lock via _draw
+                    self._injected += 1
+                self._note(kind)
+                return kind
+        return None
+
+    def _window_decision(self, op_kind: str, port: Optional[int]):
+        """The wall-clock side of the schedule.  Consumes the WINDOW
+        rng stream only for ops that fall inside an active window, so
+        the base stream stays untouched.  Guarded-by: _lock."""
+        kinds = self._active_window_kinds()
+        if kinds is None:
+            return None
+        targeted = (self.target_ports is None
+                    or (port is not None
+                        and port in self.target_ports))
+        if "partition" in kinds and op_kind == "connect":
+            # deterministic: the whole window is a refused link
+            if not targeted:
+                return None
+            self._note("partition", window=True)
+            return "partition"
+        drawable = [k for k in ("reset", "truncate", "delay")
+                    if k in kinds]
+        if not drawable:
+            return None
+        w = float(self._wrng.random())
+        if w >= self.window_rate:
+            return None
+        kind = drawable[min(int(w * len(drawable) / self.window_rate),
+                            len(drawable) - 1)]
+        if kind == "truncate" and op_kind != "send":
+            return None  # only sends can truncate
+        if not targeted:
+            return None
+        if kind in ("reset", "truncate"):
+            # window fires share the retry budget with the base
+            # schedule — a seeded drill still provably fits it
+            if (self.max_injections is not None
+                    and self._injected >= self.max_injections):
+                return None
+            # lint: allow(guarded-write) — under _lock via _draw
+            self._injected += 1
+        self._note(kind, window=True)
+        return kind
+
+    def _active_window_kinds(self):
+        """Kinds of the first window covering the current clock
+        reading, or None outside every window.  Guarded-by: _lock."""
+        if self._clock is not None:
+            t = float(self._clock())
+        else:
+            if self._t0 is None:
+                self._t0 = telemetry.now()
+            t = telemetry.now() - self._t0
+        for t_start, t_end, kinds in self.windows:
+            if t_start <= t < t_end:
+                return kinds
+        return None
 
     def _in_partition_window(self, op: int) -> bool:
         """Pure arithmetic on the op index (NO rng): is ``op`` inside a
@@ -309,6 +411,8 @@ class ChaosTransport:
                           transport.send_msg_gather,
                           transport.recv_msg_into)
             self._installed = True
+            if self._clock is None and self._t0 is None:
+                self._t0 = telemetry.now()  # window t=0 is install time
             transport.connect = self._connect
             transport.send_msg = self._send_msg
             transport.recv_msg = self._recv_msg
@@ -349,6 +453,33 @@ class ChaosTransport:
     @property
     def total_injected(self) -> int:
         return sum(self.counts.values())
+
+
+def _validate_windows(windows) -> tuple:
+    """Normalize ``[(t_start, t_end, kinds)]`` to a tuple of
+    ``(float, float, frozenset)`` triples, validating eagerly so a bad
+    drill script fails at construction, not mid-run."""
+    out = []
+    for w in windows:
+        try:
+            t_start, t_end, kinds = w
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"window {w!r} is not (t_start, t_end, kinds)")
+        t_start, t_end = float(t_start), float(t_end)
+        if not (0.0 <= t_start < t_end):
+            raise ValueError(
+                f"window times ({t_start}, {t_end}) need "
+                f"0 <= t_start < t_end")
+        if isinstance(kinds, str):
+            kinds = (kinds,)
+        kinds = frozenset(kinds)
+        if not kinds or not kinds <= set(KINDS):
+            raise ValueError(
+                f"window kinds {sorted(kinds)} must be a nonempty "
+                f"subset of {KINDS}")
+        out.append((t_start, t_end, kinds))
+    return tuple(out)
 
 
 def _peer_port(sock) -> Optional[int]:

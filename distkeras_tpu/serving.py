@@ -2282,6 +2282,16 @@ class DecodeEngine:
         return (any(p.live() or p.queue for p in self._pools)
                 or bool(self._parked))
 
+    def load(self) -> dict:
+        """Occupancy snapshot for load hooks (the traffic simulator's
+        per-replica observable): queued admissions, live slots, and
+        preempted-parked requests."""
+        with self._lock:
+            return {"queued": sum(len(p.queue) for p in self._pools),
+                    "live": sum(1 for p in self._pools
+                                for r in p.reqs if r is not None),
+                    "parked": len(self._parked)}
+
     def step(self) -> list[dict]:
         """Admit waiting requests into free slots, advance every live
         bucket by ``steps_per_sync`` tokens, evict newly finished

@@ -1136,6 +1136,10 @@ class SLOWatchdog:
         # window, and when it first appeared (both under _lock)
         self._pending_state: str | None = None
         self._pending_since = 0.0
+        # violation accounting: clock stamp of the previous evaluate
+        # (guarded-by _lock); the interval since it is attributed to
+        # the state that was COMMITTED across it
+        self._accrual_t: float | None = None
         self._lock = threading.Lock()
         self._last: dict = {"state": "ok", "signals": {},
                             "breaches": {}}
@@ -1256,6 +1260,19 @@ class SLOWatchdog:
         t = now() if now_s is None else float(now_s)
         with self._lock:
             prev = self._last["state"]
+            # violation-minutes accrual (ISSUE 18): the time since the
+            # previous evaluation was spent in the previously COMMITTED
+            # state — integrate it before this pass can transition.
+            # Closed out on every evaluate(), which includes registry
+            # ``health()`` reads and the background loop, so
+            # ``slo_violation_seconds_total{state}`` is current
+            # whenever it is scraped.
+            if (prev != "ok" and self._accrual_t is not None
+                    and t > self._accrual_t):
+                self.registry.counter(
+                    "slo_violation_seconds_total",
+                    state=prev).inc(t - self._accrual_t)
+            self._accrual_t = t
             if raw == prev or not self.sustain_secs:
                 # agreement (or edge-trigger mode): commit instantly
                 # and disarm any pending transition
@@ -1371,7 +1388,8 @@ class Autoscaler:
                  idle_sustain_s: float = 60.0,
                  interval_s: float = 1.0,
                  ps_scale_signals=("ps_lock_wait", "staleness_p99"),
-                 gateway_scale_signals=("queue_depth", "ttft_p95_s")):
+                 gateway_scale_signals=("queue_depth", "ttft_p95_s"),
+                 busy=None):
         for name, sigs in (("ps_scale_signals", ps_scale_signals),
                            ("gateway_scale_signals",
                             gateway_scale_signals)):
@@ -1405,6 +1423,14 @@ class Autoscaler:
         self.interval_s = float(interval_s)
         self.ps_scale_signals = tuple(ps_scale_signals)
         self.gateway_scale_signals = tuple(gateway_scale_signals)
+        # busy-guard (ISSUE 18 fix): a zero-arg callable; truthy means
+        # a rolling_update / live migration is mid-flight and verbs
+        # must NOT interleave with it.  ``step`` defers every executed
+        # decision (reason="deferred: busy", counted in
+        # ``autoscale_deferred_total{domain}``) and retries next tick —
+        # no cooldown is started, so the deferral costs one interval,
+        # not a cooldown window.
+        self.busy = busy
         # per-domain policy state: last time the domain's signals were
         # in breach (idle tracking) and last time an action executed
         # (cooldown).  Seeded "now" lazily on the first step so a
@@ -1511,7 +1537,16 @@ class Autoscaler:
             if any(k in breaches for k in sigs):
                 self._last_breach[domain] = t
         m = metrics()
+        busy_now = (bool(decisions) and self.busy is not None
+                    and bool(self.busy()))
         for d in decisions:
+            if d["executed"] and busy_now:
+                # a reshard / rolling update is in flight: defer rather
+                # than interleave verbs with it (retry next tick)
+                d["executed"] = False
+                d["reason"] = "deferred: busy"
+                m.counter("autoscale_deferred_total",
+                          domain=d["domain"]).inc()
             if d["executed"]:
                 try:
                     getattr(self, self._VERBS[d["action"]])()

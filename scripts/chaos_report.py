@@ -386,7 +386,11 @@ def registry_lines(tel) -> list[str]:
     telemetry registry."""
     lines = ["== telemetry recovery summary =="]
     snap = tel.metrics.snapshot()
-    wanted = ("chaos_injected_total", "ps_client_retries_total",
+    wanted = ("chaos_injected_total", "chaos_window_injected_total",
+              "sim_kills_total", "slo_violation_seconds_total",
+              "autoscale_deferred_total",
+              "sim_drill_convergence_seconds_total",
+              "ps_client_retries_total",
               "ps_commits_total", "ps_commit_dedup_total",
               "ps_snapshots_total", "ps_restarts_total",
               "ps_promotions_total", "ps_client_failovers_total",

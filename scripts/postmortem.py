@@ -29,6 +29,12 @@ When the ring holds elastic-scaling events (ISSUE 14 —
 autoscaler decision (suppressed ones included, with the breaching
 signal and value) and every topology change, in wall-clock order.
 
+When the ring holds traffic-drill events (ISSUE 18 — ``sim_phase``,
+``sim_kill``, windowed ``chaos`` fires, ``slo_state``,
+``drill_converged``), the report replays the drill story too: load
+phase changes, scheduled kills, fault-window fires, SLO transitions,
+and how long capacity took to converge back to target.
+
 Modes:
 
 * ``--flight DIR [--seconds 30] [--snapshot ps.snap]`` — report on an
@@ -132,6 +138,40 @@ def scaling_story(events: list[dict]) -> list[dict]:
     return out
 
 
+def drill_story(events: list[dict]) -> list[dict]:
+    """The traffic-drill timeline (ISSUE 18): load phases
+    (``sim_phase`` — base/flash-crowd transitions), scheduled kills
+    (``sim_kill``), transport fault windows firing (``chaos`` events
+    with ``window=True``), SLO state transitions (``slo_state``), and
+    capacity convergence (``drill_converged``) — the "what did the
+    load do, what did we break, how fast did capacity catch up" story
+    beside ``scaling_story``'s verb-level view."""
+    out = []
+    for e in sorted((e for e in events if e["kind"] in (
+            "sim_phase", "sim_kill", "slo_state", "drill_converged")
+            or (e["kind"] == "chaos" and e.get("window"))),
+            key=lambda e: e["wall_s"]):
+        k = e["kind"]
+        if k == "sim_phase":
+            what = (f"load phase -> {e['phase']} "
+                    f"(trace t={e['sim_t']:.2f}s)")
+        elif k == "sim_kill":
+            what = (f"scheduled kill: {e['target']} "
+                    f"(trace t={e['sim_t']:.2f}s)")
+        elif k == "slo_state":
+            what = (f"SLO {e.get('previous')} -> {e['state']}"
+                    + (f" on {','.join(e['breaches'])}"
+                       if e.get("breaches") else ""))
+        elif k == "drill_converged":
+            what = (f"capacity converged to target {e['target']} "
+                    f"after {e['seconds']:.2f}s "
+                    f"(trace t={e['sim_t']:.2f}s)")
+        else:  # chaos window fault
+            what = f"fault window fired: {e['fault']} (op {e['op']})"
+        out.append({"wall_s": e["wall_s"], "kind": k, "what": what})
+    return out
+
+
 def reconstruct(flight_dir: str, seconds: float = 30.0,
                 snapshot: str | None = None) -> dict:
     """The postmortem: crash marker, event window, per-worker
@@ -167,6 +207,9 @@ def reconstruct(flight_dir: str, seconds: float = 30.0,
     scaling = scaling_story(window)
     if scaling:
         report["scaling_story"] = scaling
+    drill = drill_story(window)
+    if drill:
+        report["drill_story"] = drill
     if snapshot is not None:
         info = ps_snapshot_info(snapshot)
         report["snapshot"] = info
@@ -209,6 +252,12 @@ def render(report: dict) -> str:
         lines.append(f"scaling story ({len(scaling)} events):")
         t0 = scaling[0]["wall_s"]
         for s in scaling:
+            lines.append(f"  +{s['wall_s'] - t0:7.3f}s {s['what']}")
+    drill = report.get("drill_story", [])
+    if drill:
+        lines.append(f"drill story ({len(drill)} events):")
+        t0 = drill[0]["wall_s"]
+        for s in drill:
             lines.append(f"  +{s['wall_s'] - t0:7.3f}s {s['what']}")
     if "snapshot" in report:
         info = report["snapshot"]

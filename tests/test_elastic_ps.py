@@ -815,3 +815,33 @@ def test_aeasgd_trains_against_elastic_group_k2():
         assert grp.num_commits == len(t.history["round_loss"])
     finally:
         grp.stop()
+
+
+def test_autoscaler_defers_while_the_gateway_is_busy():
+    """ISSUE 18 fix: while a rolling update / migration is in flight
+    (``busy()`` truthy) the autoscaler records its decision but defers
+    the verb — and deferral costs one tick, NOT a cooldown window, so
+    the very next quiet-gateway tick executes."""
+    tel = telemetry.enable()
+    try:
+        calls = []
+        busy = {"v": True}
+        sc = _scaler(tel, spawn_replica=lambda: calls.append(1),
+                     replica_count=lambda: 1 + len(calls),
+                     max_replicas=4, busy=lambda: busy["v"])
+        d, = sc.step(_breach("queue_depth", value=300.0), now_s=0.0)
+        assert not d["executed"]
+        assert d["reason"] == "deferred: busy" and calls == []
+        assert tel.metrics.counter("autoscale_deferred_total",
+                                   domain="gateway").value == 1
+        # cooldown_s is 30 here: if the deferral had counted as an
+        # action, this tick would report "cooldown" instead of acting
+        busy["v"] = False
+        d, = sc.step(_breach("queue_depth", value=300.0), now_s=1.0)
+        assert d["executed"] and calls == [1]
+        # quiesced gateway: the guard never fires on empty decisions
+        assert sc.step(_QUIET, now_s=2.0) == []
+        assert tel.metrics.counter("autoscale_deferred_total",
+                                   domain="gateway").value == 1
+    finally:
+        telemetry.disable()

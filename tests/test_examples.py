@@ -29,6 +29,7 @@ SMOKE_SCRIPTS = {
     "lint_static.py": ["--smoke"],
     "obs_report.py": ["--smoke"],
     "perf_attrib.py": ["--smoke"],
+    "perf_capacity.py": ["--smoke"],
     "perf_elastic.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],

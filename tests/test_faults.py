@@ -392,3 +392,88 @@ def test_target_ports_scopes_firing_but_not_the_schedule():
            for k in range(60)]
     assert all(g is None for g in got[1::2])  # off-target never fires
     assert got[0::2] == want[0::2]  # same stream at the same indices
+
+
+# ---- wall-clock fault windows (ISSUE 18) -------------------------------
+
+
+def test_wall_clock_window_fires_only_inside_and_is_pure():
+    """``windows=[(t0, t1, kinds)]`` composes a wall-clock phase onto
+    the op-counter schedule: silent outside [t0, t1), only the listed
+    kinds inside — and with an injectable clock the whole composite
+    stays a pure function of the seed (two same-seed instances agree
+    decision for decision along the same clock path)."""
+    clk = {"t": 0.0}
+    kw = dict(reset_rate=0.0, truncate_rate=0.0, delay_rate=0.0,
+              delay_s=0.0, windows=((1.0, 2.0, ("reset", "delay")),),
+              window_rate=1.0, clock=lambda: clk["t"])
+    a = ChaosTransport(seed=5, **kw)
+    b = ChaosTransport(seed=5, **kw)
+    clk["t"] = 0.5  # before the window: nothing fires
+    assert [a._draw("send") for _ in range(10)] == [None] * 10
+    clk["t"] = 1.5  # inside, window_rate=1.0: every op fires
+    da = [a._draw(k) for k in ["send", "recv"] * 10]
+    assert set(da) == {"reset", "delay"}  # only the window's kinds
+    clk["t"] = 2.5  # past the end: silent again
+    assert [a._draw("send") for _ in range(10)] == [None] * 10
+    # purity: b replayed along the same clock path agrees exactly
+    clk["t"] = 0.5
+    assert [b._draw("send") for _ in range(10)] == [None] * 10
+    clk["t"] = 1.5
+    assert [b._draw(k) for k in ["send", "recv"] * 10] == da
+    assert a.counts == b.counts and a.counts["reset"] > 0
+
+
+def test_window_stream_leaves_the_base_schedule_untouched():
+    """Regression: configuring windows must not perturb the base
+    op-counter schedule — the window draws come from a SEPARATE rng
+    stream, consumed only inside an active window."""
+    kw = dict(reset_rate=0.2, truncate_rate=0.15, delay_rate=0.1,
+              delay_s=0.0)
+    ops = (["send", "recv", "connect"] * 30)[:80]
+    plain = ChaosTransport(seed=3, **kw)
+    armed = ChaosTransport(seed=3, windows=((1e9, 2e9, "reset"),),
+                           clock=lambda: 0.0, **kw)
+    assert ([plain._draw(k) for k in ops]
+            == [armed._draw(k) for k in ops])
+    assert plain.counts == armed.counts
+
+
+def test_window_partition_refuses_connects_deterministically():
+    """A ``partition`` window needs no rng: every connect inside it is
+    refused, sends pass (partition cuts links, not payloads)."""
+    clk = {"t": 1.5}
+    ct = ChaosTransport(seed=0, windows=((1.0, 2.0, "partition"),),
+                        clock=lambda: clk["t"])
+    assert all(ct._draw("connect") == "partition" for _ in range(5))
+    assert ct._draw("send") is None
+    clk["t"] = 3.0  # healed
+    assert ct._draw("connect") is None
+    assert ct.counts["partition"] == 5
+
+
+def test_window_reset_shares_the_injection_budget():
+    clk = {"t": 0.5}
+    ct = ChaosTransport(seed=1, reset_rate=0.0, truncate_rate=0.0,
+                        delay_rate=0.0,
+                        windows=((0.0, 10.0, "reset"),),
+                        window_rate=1.0, max_injections=3,
+                        clock=lambda: clk["t"])
+    fired = [ct._draw("send") for _ in range(20)]
+    assert fired.count("reset") == 3  # capped by the shared budget
+    assert ct.total_injected == 3
+
+
+def test_window_validation():
+    from distkeras_tpu.parallel.faults import _validate_windows
+
+    for bad in (((2.0, 1.0, "reset"),),      # end before start
+                ((-1.0, 1.0, "reset"),),     # negative start
+                ((0.0, 1.0, ()),),           # no kinds
+                ((0.0, 1.0, "bogus"),)):     # unknown kind
+        with pytest.raises(ValueError):
+            _validate_windows(bad)
+    ws = _validate_windows(((0.0, 1.0, "reset"),))  # bare kind ok
+    assert ws[0][2] == frozenset({"reset"})
+    with pytest.raises(ValueError):
+        ChaosTransport(seed=0, window_rate=1.5)

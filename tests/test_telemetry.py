@@ -811,3 +811,38 @@ def test_chaos_kill_restart_traced_flight_and_postmortem(tmp_path, tel):
     for w, s in report["flight_last_acked"].items():
         assert int(s) <= acked[w]
     assert "postmortem" in pm.render(report)
+
+
+def test_slo_violation_seconds_accrue_by_state(tel):
+    """ISSUE 18: every evaluation closes out the time spent in the
+    previously committed non-ok state onto
+    ``slo_violation_seconds_total{state}`` — the drill's
+    violation-minutes metric is a pure time integral, testable with an
+    injected clock."""
+    reg = telemetry.MetricsRegistry()
+    w = telemetry.SLOWatchdog(
+        reg, thresholds={"queue_depth": (3.0, 10.0)},
+        sustain_secs=0.0)  # edge-trigger: transitions commit at once
+    q = reg.gauge("serving_queue_depth", bucket=16)
+
+    def acc(state):
+        return reg.counter("slo_violation_seconds_total",
+                           state=state).value
+
+    assert w.evaluate(now_s=0.0)["state"] == "ok"
+    q.set(5.0)
+    assert w.evaluate(now_s=10.0)["state"] == "degraded"
+    assert acc("degraded") == 0.0  # the 0..10 span was spent ok
+    assert w.evaluate(now_s=12.0)["state"] == "degraded"
+    assert acc("degraded") == pytest.approx(2.0)
+    q.set(20.0)
+    assert w.evaluate(now_s=15.0)["state"] == "critical"
+    assert acc("degraded") == pytest.approx(5.0)  # closed on the flip
+    assert w.evaluate(now_s=18.0)["state"] == "critical"
+    q.set(0.0)
+    assert w.evaluate(now_s=20.0)["state"] == "ok"
+    assert acc("critical") == pytest.approx(5.0)
+    assert w.evaluate(now_s=25.0)["state"] == "ok"
+    # ok time never accrues; the totals are final
+    assert acc("degraded") == pytest.approx(5.0)
+    assert acc("critical") == pytest.approx(5.0)
