@@ -71,10 +71,11 @@ from typing import Any, Callable, Iterable, Iterator, Mapping, Optional
 import jax
 import numpy as np
 
-from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu import flight_recorder, paging, telemetry
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
-from distkeras_tpu.serving import ShedError
+from distkeras_tpu.serving import (ShedError, pack_kv_blocks,
+                                   unpack_kv_blocks)
 
 _UNSET = object()
 
@@ -230,6 +231,39 @@ class EngineReplica:
         if isinstance(res, Exception):
             raise res
 
+    def _kv_call(self, op: str, payload, timeout: float):
+        """Run one prefix-store interchange op on the DRIVER thread
+        (the store's ownership discipline — see ``DecodeEngine.
+        export_prefix``) and block for its result."""
+        fut = _Future()
+        with self._cv:
+            if not self._alive:
+                raise ReplicaDown(f"replica {self.name} is down")
+            self._mailbox.append(("kv", (op, payload), fut.set))
+            self._cv.notify_all()
+        res = fut.wait(timeout)
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def kv_probe(self, prompt, timeout: float = 60.0) -> int:
+        """Leading prompt blocks the engine's prefix store already
+        holds (the router's ship-only-what's-missing check)."""
+        return self._kv_call("probe", prompt, timeout)
+
+    def kv_export(self, prompt, timeout: float = 60.0):
+        """The engine's cached prefix blocks for ``prompt`` as a host
+        export dict (``None``: nothing cached) — the prefill side of
+        the disaggregated handoff."""
+        return self._kv_call("export", prompt, timeout)
+
+    def kv_import(self, export: Mapping,
+                  timeout: float = 60.0) -> int:
+        """Install a shipped block set into the engine's prefix store;
+        returns blocks newly installed — the decode side of the
+        handoff."""
+        return self._kv_call("import", export, timeout)
+
     def variables(self) -> Mapping:
         """The engine's current weights (read-only use: the rollback
         snapshot).  Safe without the driver — ``swap_variables``
@@ -295,6 +329,20 @@ class EngineReplica:
             try:
                 self.engine.swap_variables(variables)
                 done(None)
+            except Exception as e:
+                done(e)
+            return
+        if cmd[0] == "kv":
+            _, (op, payload), done = cmd
+            try:
+                if op == "probe":
+                    done(self.engine.match_blocks(payload))
+                elif op == "export":
+                    done(self.engine.export_prefix(payload))
+                else:
+                    done(self.engine.import_prefix(
+                        payload["prompt"], payload["blocks"],
+                        payload.get("weights_ver")))
             except Exception as e:
                 done(e)
             return
@@ -379,9 +427,15 @@ class EngineReplica:
 #   b"v"                       -> pack_obj(variables)     (rollback src)
 #   b"q"                       -> pack_obj({"ok"| "error"}) (quiesce)
 #   b"s"                       -> connection closes        (stop server)
+#   b"y" + pack_obj(prompt)    -> pack_obj({"blocks"|"error"}) (kv probe)
+#   b"x" + pack_obj(prompt)    -> kv page-blocks frame     (kv export)
+#   b"k" + kv page-blocks body -> pack_obj({"imported"|"error"})
 # Payloads are flax msgpack (``pack_obj``) — self-describing, never
 # pickle; a generate connection stays open for the whole request, so a
-# severed wire maps 1:1 to a failed attempt.
+# severed wire maps 1:1 to a failed attempt.  The kv page-blocks frame
+# is ``serving.pack_kv_blocks``'s gather-sent wire form (scope
+# ``"kv"``): raw page memoryviews behind a length-prefixed msgpack
+# meta, so exported KV never round-trips through msgpack arrays.
 
 
 def _exc_error(e: Exception) -> str:
@@ -498,6 +552,30 @@ class ReplicaServer:
             try:
                 rep.quiesce()
                 out = {"ok": True}
+            except Exception as e:
+                out = {"error": _exc_error(e)}
+            transport.send_msg(conn, transport.pack_obj(out))
+        elif cmd == b"y":
+            prompt = np.asarray(transport.unpack_obj(body), np.int32)
+            try:
+                out = {"blocks": int(rep.kv_probe(prompt))}
+            except Exception as e:
+                out = {"error": _exc_error(e)}
+            transport.send_msg(conn, transport.pack_obj(out))
+        elif cmd == b"x":
+            prompt = np.asarray(transport.unpack_obj(body), np.int32)
+            try:
+                export = rep.kv_export(prompt)
+            except Exception:
+                export = None  # export is best-effort: reply empty,
+                #                the importer recomputes instead
+            if export is None:
+                export = {"prompt": prompt, "blocks": []}
+            transport.send_msg_gather(conn, *pack_kv_blocks(export))
+        elif cmd == b"k":
+            try:
+                out = {"imported": int(rep.kv_import(
+                    unpack_kv_blocks(body)))}
             except Exception as e:
                 out = {"error": _exc_error(e)}
             transport.send_msg(conn, transport.pack_obj(out))
@@ -661,6 +739,66 @@ class RemoteReplica:
             raise TimeoutError(
                 f"remote quiesce failed: {out['error']}")
 
+    # -- disaggregated prefill/decode handoff -------------------------
+
+    def kv_probe(self, prompt, timeout: float = 60.0) -> int:
+        try:
+            out = self._exchange(
+                b"y",
+                transport.pack_obj(np.asarray(prompt, np.int32)),
+                timeout=timeout)
+        except (ConnectionError, OSError) as e:
+            self._mark_down(e)
+            raise
+        if "error" in out:
+            raise ReplicaDown(f"kv_probe failed: {out['error']}")
+        return int(out["blocks"])
+
+    def kv_export(self, prompt, timeout: float = 60.0):
+        """Pull a prompt's cached KV blocks off the remote replica —
+        the reply is the raw kv page-blocks frame (``unpack_kv_blocks``
+        decodes it in place on the receive buffer, no msgpack detour
+        for the page bytes).  ``None`` when nothing is cached."""
+        sock = transport.connect(self.host, self.port,
+                                 timeout=self.connect_timeout)
+        try:
+            sock.settimeout(timeout)
+            hdr = transport.trace_header()
+            transport.send_msg(
+                sock, hdr + b"x",
+                transport.pack_obj(np.asarray(prompt, np.int32)))
+            export = unpack_kv_blocks(transport.recv_msg_into(sock))
+        except (ConnectionError, OSError) as e:
+            self._mark_down(e)
+            raise
+        finally:
+            with contextlib.suppress(OSError):
+                sock.close()
+        return export if export["n_blocks"] else None
+
+    def kv_import(self, export: Mapping,
+                  timeout: float = 60.0) -> int:
+        """Ship a block set into the remote replica's prefix store —
+        ONE gather-sent frame, the page memoryviews riding ``sendmsg``
+        with zero send-side copies."""
+        sock = transport.connect(self.host, self.port,
+                                 timeout=self.connect_timeout)
+        try:
+            sock.settimeout(timeout)
+            hdr = transport.trace_header()
+            parts = pack_kv_blocks(export)
+            transport.send_msg_gather(sock, hdr + b"k", *parts)
+            out = transport.unpack_obj(transport.recv_msg(sock))
+        except (ConnectionError, OSError) as e:
+            self._mark_down(e)
+            raise
+        finally:
+            with contextlib.suppress(OSError):
+                sock.close()
+        if "error" in out:
+            raise ReplicaDown(f"kv_import failed: {out['error']}")
+        return int(out["imported"])
+
     def health(self) -> dict:
         try:
             out = self._exchange(b"h",
@@ -728,6 +866,13 @@ def _classify(res) -> str:
 def _cause(res) -> str:
     return repr(res) if isinstance(res, Exception) \
         else str(res.get("error"))
+
+
+def _free_pages(rep) -> Optional[int]:
+    """A replica's page headroom, ``None`` for envelope replicas (or
+    anything not exposing the signal) — the shared routing probe."""
+    fn = getattr(rep, "free_pages", None)
+    return fn() if callable(fn) else None
 
 
 class ServingGateway:
@@ -859,18 +1004,23 @@ class ServingGateway:
                eos_id=_UNSET, request_id=None, deadline=_UNSET,
                session=None, meta: Optional[Mapping] = None,
                tenant=None, priority: Optional[int] = None,
-               speculative=None):
+               speculative=None, handoff: bool = False):
         """Queue one request; returns its id.  ``session`` is the
         affinity key for the ``session`` policy; ``tenant``/
         ``priority`` ride through to the engine's QoS scheduler
         (inert on envelope-pool replicas); ``speculative`` is the
         per-request speculation override, forwarded only when set
         (replicas without an engine-level ``speculative=`` config
-        reject it).  Explicit ``request_id``s
+        reject it); ``handoff`` marks a disaggregated decode-side
+        dispatch whose KV pages already shipped in, exempting it
+        from the page-exhaustion routing exclusion (it never reaches
+        the engine).  Explicit ``request_id``s
         must be unique among unresolved gateway requests (and
         msgpack-encodable for remote replicas)."""
         self.start()
         spec: dict = {"prompt": np.asarray(prompt, np.int32)}
+        if handoff:
+            spec["handoff"] = True
         if max_new_tokens is not None:
             spec["max_new_tokens"] = int(max_new_tokens)
         if eos_id is not _UNSET:
@@ -994,14 +1144,26 @@ class ServingGateway:
                 return None
             fresh = [r for r in cands if r.name not in req.tried]
             cands = fresh or cands  # all tried: go around again
+            if not req.spec.get("handoff"):
+                # a paged replica with ZERO free pages cannot admit a
+                # fresh prefill without parking or shedding it — skip
+                # page-exhausted replicas for NEW admissions under
+                # every policy.  Handoff dispatches are exempt: the
+                # disaggregated router already page-checked its decode
+                # target, and excluding it here would unstick the
+                # request from the replica its KV just shipped to.
+                # All-exhausted falls through unchanged (the engine's
+                # own parking/shedding beats a gateway-level drop).
+                roomy = [r for r in cands
+                         if _free_pages(r) != 0]
+                cands = roomy or cands
             if self.policy == "least_loaded":
                 # ties on load break on paged headroom (more free KV
                 # pages first, so paged replicas absorb the burst);
                 # envelope replicas report None and sort as 0 —
                 # between queue depth and an exhausted paged pool
                 def _key(r):
-                    fn = getattr(r, "free_pages", None)
-                    fp = fn() if callable(fn) else None
+                    fp = _free_pages(r)
                     return (r.load(), 0 if fp is None else -fp,
                             r.name)
                 return min(cands, key=_key)
@@ -1367,6 +1529,428 @@ class ServingGateway:
                 rep = by_name[name]
                 if rep.alive:
                     self._swap_one(rep, old_vars, quiesce_timeout)
+
+
+# ---------------------------------------------------------------------
+# disaggregated prefill/decode
+# ---------------------------------------------------------------------
+
+
+class PrefillDecodeRouter:
+    """Two-stage disaggregated serving (the DistServe / Splitwise
+    split): a PREFILL pool computes prompt KV, a DECODE pool owns
+    token generation, and finished KV page blocks ship between them
+    over the prefix-store interchange (``DecodeEngine.export_prefix``
+    → wire scope ``"kv"`` → ``import_prefix``).
+
+    Why: on a monolithic replica a long-prompt flood interleaves
+    prefill programs with every live slot's decode steps, so INTER-
+    TOKEN latency degrades fleet-wide.  Here the flood queues at the
+    prefill pool — ``max_inflight_handoffs`` bounds prefill+export
+    work in flight, the back-pressure valve — while decode replicas
+    keep their step cadence (``scripts/perf_prefill_decode.py`` gates
+    decode-side p99 flood-flatness on exactly this).
+
+    Request lifecycle:
+
+    * a SHORT prompt (under one whole ``block_size`` block — nothing
+      exportable) routes straight to the decode pool;
+    * a LONG prompt runs the pipeline: the least-loaded prefill
+      replica generates ONE token (its donation path warms the
+      prefill-side prefix store), ``kv_export`` pulls the prompt's
+      blocks, then the router picks a decode replica with page
+      headroom (``free_pages() >= `` the request's worst-case page
+      need; envelope replicas always qualify), probes the target's
+      LOCAL store first (``kv_probe`` — the cluster-tier rung: ship
+      only when the decode side doesn't already hold the blocks),
+      ``kv_import``s the set (``serving_kv_pages_shipped_total``
+      counts shipped blocks), and dispatches the real request with
+      ``handoff=True``.  Decode-side admission takes the ordinary
+      prefix-hit path, so tokens are byte-identical to a monolithic
+      engine by construction.
+    * a dead prefill pool degrades gracefully: the request falls
+      through to the decode pool and recomputes its prefill there.
+
+    Failure discipline mirrors ``ServingGateway``: seeded full-jitter
+    backoff, ``retries`` extra attempts per stage, first-completion-
+    wins futures (exactly-once delivery), and a decode replica dying
+    mid-handoff requeues the request onto a survivor — counted by
+    ``serving_handoff_requeue_total`` plus a ``handoff_requeue``
+    flight event (the seeded chaos test pins exactly-once delivery
+    under the kill).
+    """
+
+    def __init__(self, prefill: Iterable, decode: Iterable, *,
+                 block_size: int, max_inflight_handoffs: int = 4,
+                 retries: int = 3, backoff_base: float = 0.02,
+                 backoff_max: float = 0.5, jitter: float = 0.5,
+                 seed: int = 0, deadline: Optional[float] = None):
+        self.prefill = list(prefill)
+        self.decode = list(decode)
+        if not self.prefill or not self.decode:
+            raise ValueError(
+                "PrefillDecodeRouter needs >= 1 replica per pool")
+        names = [r.name for r in (*self.prefill, *self.decode)]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        if block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1; got {block_size}")
+        if max_inflight_handoffs < 1:
+            raise ValueError(f"max_inflight_handoffs must be >= 1; "
+                             f"got {max_inflight_handoffs}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0; got {retries}")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"jitter={jitter} outside [0, 1]")
+        # align block_size with the engines' page_size/prefill_align:
+        # it sizes both the short-prompt cutoff and the page-headroom
+        # requirement
+        self.block_size = int(block_size)
+        self.retries = int(retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self._rng = np.random.default_rng(seed)
+        self._lock = racecheck.lock("gateway.pd_router")
+        self._requests: dict[Any, tuple] = {}  # rid -> (spec, future)
+        self._n_auto = itertools.count()
+        self._handoffs = threading.Semaphore(
+            int(max_inflight_handoffs))
+        self._closing = False  # guarded-by: _lock
+        self._started = False  # guarded-by: _lock
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "PrefillDecodeRouter":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+        for rep in (*self.prefill, *self.decode):
+            rep.start()
+        # pre-touch: the handoff counters must exist (at zero) in
+        # every snapshot obs_report reads, handoffs or none
+        m = telemetry.metrics()
+        m.counter("serving_kv_pages_shipped_total").inc(0)
+        m.counter("serving_handoff_requeue_total").inc(0)
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        for rep in (*self.prefill, *self.decode):
+            if isinstance(rep, EngineReplica):
+                rep.stop()
+        with self._lock:
+            reqs = list(self._requests.items())
+        for rid, (spec, fut) in reqs:
+            if not fut.ready():
+                fut.set(self._error_result(rid, spec,
+                                           "gateway_closed"))
+
+    def __enter__(self) -> "PrefillDecodeRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt, *,
+               max_new_tokens: Optional[int] = None, eos_id=_UNSET,
+               request_id=None, deadline=_UNSET,
+               meta: Optional[Mapping] = None, tenant=None,
+               priority: Optional[int] = None):
+        """Queue one request through the two-stage pipeline; returns
+        its id.  Same result contract as ``ServingGateway.submit``."""
+        self.start()
+        spec: dict = {"prompt": np.asarray(prompt, np.int32)}
+        if max_new_tokens is not None:
+            spec["max_new_tokens"] = int(max_new_tokens)
+        if eos_id is not _UNSET:
+            spec["eos_id"] = eos_id
+        dl = self.deadline if deadline is _UNSET else deadline
+        if dl is not None:
+            spec["deadline"] = float(dl)
+        if meta:
+            spec["meta"] = dict(meta)
+        if tenant is not None:
+            spec["tenant"] = tenant
+        if priority is not None:
+            spec["priority"] = int(priority)
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("router is closed")
+            if request_id is None:
+                rid = f"pd-{next(self._n_auto)}"
+                while rid in self._requests:
+                    rid = f"pd-{next(self._n_auto)}"
+            else:
+                rid = request_id
+                if rid in self._requests:
+                    raise ValueError(
+                        f"request_id {rid!r} is already in flight")
+            spec["request_id"] = rid
+            fut = _Future()
+            self._requests[rid] = (spec, fut)
+        threading.Thread(target=self._run_one, args=(rid, spec, fut),
+                         daemon=True,
+                         name=f"dkt-pd-{rid}").start()
+        return rid
+
+    def result(self, request_id,
+               timeout: Optional[float] = None) -> dict:
+        """Block for (and consume) one request's result."""
+        with self._lock:
+            ent = self._requests.get(request_id)
+        if ent is None:
+            raise KeyError(f"unknown request_id {request_id!r}")
+        res = ent[1].wait(timeout)
+        with self._lock:
+            self._requests.pop(request_id, None)
+        return res
+
+    def try_result(self, request_id):
+        """Non-blocking ``result`` (``None``: still in flight)."""
+        with self._lock:
+            ent = self._requests.get(request_id)
+            if ent is None:
+                raise KeyError(f"unknown request_id {request_id!r}")
+            if not ent[1].ready():
+                return None
+            self._requests.pop(request_id, None)
+        return ent[1].wait(0)
+
+    def run(self, requests: Iterable, *, ordered: bool = True
+            ) -> Iterator[dict]:
+        """Serve an iterable to completion — one result per item,
+        same item forms as ``ServingGateway.run`` (minus ``session``/
+        ``speculative``, which have no disaggregated meaning yet)."""
+        rids = [self._submit_item(item) for item in requests]
+        if ordered:
+            for rid in rids:
+                yield self.result(rid)
+            return
+        pending = set(rids)
+        while pending:
+            done = [rid for rid in pending
+                    if self._requests[rid][1].ready()]
+            for rid in done:
+                pending.discard(rid)
+                yield self.result(rid)
+            if not done:
+                _sleep(0.002)
+
+    def _submit_item(self, item):
+        if isinstance(item, Mapping):
+            meta = {k: v for k, v in item.items()
+                    if k not in ("prompt", "max_new_tokens", "eos_id",
+                                 "deadline", "tenant", "priority")}
+            return self.submit(
+                item["prompt"],
+                max_new_tokens=item.get("max_new_tokens"),
+                eos_id=item.get("eos_id", _UNSET),
+                deadline=item.get("deadline", _UNSET),
+                tenant=item.get("tenant"),
+                priority=item.get("priority"), meta=meta)
+        return self.submit(item)
+
+    # -- the pipeline -------------------------------------------------
+
+    def _run_one(self, rid, spec: dict, fut: _Future) -> None:
+        try:
+            prompt = spec["prompt"]
+            export = None
+            if len(prompt) // self.block_size > 0:
+                with self._handoffs:  # back-pressure: floods wait HERE
+                    export = self._prefill_stage(rid, spec, fut)
+                if fut.ready():
+                    return
+            self._decode_stage(rid, spec, fut, export)
+        except Exception as e:  # never leak a waiter
+            fut.set(self._error_result(rid, spec, f"router: {e!r}"))
+
+    def _prefill_stage(self, rid, spec: dict, fut: _Future):
+        """Prefill the prompt on the prefill pool and pull its KV
+        blocks.  Best-effort by design: every failure path returns
+        ``None`` and the decode stage recomputes — degraded latency,
+        never a lost request."""
+        prompt = spec["prompt"]
+        rep = None
+        for attempt in range(self.retries + 1):
+            if self._closing or fut.ready():
+                return None
+            rep = self._pick(self.prefill)
+            if rep is None:
+                self._backoff(attempt + 1)
+                continue
+            pspec = {"prompt": prompt, "max_new_tokens": 1,
+                     "request_id": f"{rid}#p{attempt}"}
+            if "deadline" in spec:
+                pspec["deadline"] = spec["deadline"]
+            att = _Future()
+            telemetry.metrics().counter(
+                "gateway_requests_total", replica=rep.name,
+                policy="prefill_decode").inc()
+            try:
+                with telemetry.span("prefill_stage", replica=rep.name,
+                                    request_id=str(rid)):
+                    rep.dispatch(pspec, att.set)
+                    res = att.wait()
+            except Exception as e:
+                res = e
+            if (_classify(res) == "final"
+                    and not isinstance(res, Exception)
+                    and res.get("error") is None):
+                break
+            self._backoff(attempt + 1)
+        else:
+            return None  # pool down/erroring: recompute on decode
+        try:
+            return rep.kv_export(prompt)
+        except Exception:
+            return None  # severed mid-export: recompute on decode
+
+    def _decode_stage(self, rid, spec: dict, fut: _Future,
+                      export) -> None:
+        m = telemetry.metrics()
+        need = paging.pages_for(
+            len(spec["prompt"]) + int(spec.get("max_new_tokens", 1)),
+            self.block_size)
+        dspec = dict(spec)
+        dspec["handoff"] = True
+        last = None
+        for attempt in range(self.retries + 1):
+            if self._closing or fut.ready():
+                return
+            rep = self._pick(self.decode, need_pages=need)
+            if rep is None:
+                last = ReplicaDown("no decode replica available")
+                self._backoff(attempt + 1)
+                continue
+            if export is not None and export["n_blocks"]:
+                try:
+                    # cluster-tier rung: the decode replica's LOCAL
+                    # store first; ship only when it is missing blocks
+                    if (rep.kv_probe(export["prompt"])
+                            < export["n_blocks"]):
+                        shipped = rep.kv_import(export)
+                        m.counter(
+                            "serving_kv_pages_shipped_total").inc(
+                                shipped)
+                except Exception as e:  # died mid-handoff: requeue
+                    last = e
+                    self._requeue(rid, rep, e, attempt)
+                    continue
+            att = _Future()
+            m.counter("gateway_requests_total", replica=rep.name,
+                      policy="prefill_decode").inc()
+            try:
+                rep.dispatch(dspec, att.set)
+                res = att.wait()
+            except Exception as e:
+                res = e
+            if _classify(res) == "final":
+                self._complete(rid, spec, fut, res)
+                return
+            last = res
+            self._requeue(rid, rep, res, attempt)
+        self._complete(rid, spec, fut, self._error_result(
+            rid, spec, f"handoff_retries_exhausted: {_cause(last)}"))
+
+    def _pick(self, pool: list, need_pages: Optional[int] = None):
+        """Least-loaded alive replica (ties: more free pages, then
+        name).  With ``need_pages``, paged replicas short of that
+        headroom are skipped — envelope replicas (``free_pages() is
+        None``) always qualify — falling back to the full candidate
+        set when every paged replica is short (the engine's own
+        parking/shedding then applies back-pressure)."""
+        cands = [r for r in pool if r.alive]
+        if not cands:
+            # down-marked remotes may only have had a transient wire
+            # fault (chaos reset, server restart) — probe before
+            # writing the whole pool off, as ServingGateway does
+            for r in pool:
+                probe = getattr(r, "probe", None)
+                if probe is not None and not r.alive:
+                    with contextlib.suppress(Exception):
+                        probe()
+            cands = [r for r in pool if r.alive]
+        if not cands:
+            return None
+        if need_pages is not None:
+            roomy = [r for r in cands
+                     if (_free_pages(r) is None
+                         or _free_pages(r) >= need_pages)]
+            cands = roomy or cands
+        def _key(r):
+            fp = _free_pages(r)
+            return (r.load(), 0 if fp is None else -fp, r.name)
+        return min(cands, key=_key)
+
+    def _requeue(self, rid, rep, cause, attempt: int) -> None:
+        telemetry.metrics().counter(
+            "serving_handoff_requeue_total").inc()
+        telemetry.metrics().counter("gateway_failovers_total",
+                                    replica=rep.name).inc()
+        flight_recorder.record("handoff_requeue", request_id=rid,
+                               replica=rep.name, cause=_cause(cause),
+                               attempt=attempt + 1)
+        self._backoff(attempt + 1)
+
+    def _backoff(self, attempt: int) -> None:
+        delay = min(self.backoff_max,
+                    self.backoff_base * 2 ** (attempt - 1))
+        with self._lock:
+            u = float(self._rng.random())
+        _sleep(delay * (1.0 - self.jitter * u))
+
+    def _complete(self, rid, spec: dict, fut: _Future, res) -> None:
+        if isinstance(res, Exception):
+            res = self._error_result(rid, spec, f"router: {res!r}")
+        fut.set(res)
+
+    def _error_result(self, rid, spec: dict, error: str) -> dict:
+        return {**spec.get("meta", {}),
+                "request_id": rid, "prompt": spec["prompt"],
+                "tokens": np.zeros((0,), np.int32), "error": error}
+
+    # -- health -------------------------------------------------------
+
+    def healthz(self) -> dict:
+        """Per-pool replica verdicts + the aggregate state:
+        ``critical`` with no decode replica alive (nothing can finish
+        a request), ``degraded`` with the prefill pool down or any
+        replica dead (capacity or the disaggregation benefit is
+        reduced), else the worst alive replica's SLO state."""
+        rank = {"ok": 0, "degraded": 1, "critical": 2}
+        pools, worst = {}, "ok"
+        alive = {"prefill": 0, "decode": 0}
+        for pool_name, pool in (("prefill", self.prefill),
+                                ("decode", self.decode)):
+            pools[pool_name] = {}
+            for rep in pool:
+                h = rep.health()
+                pools[pool_name][rep.name] = h
+                if h.get("alive"):
+                    alive[pool_name] += 1
+                    s = h.get("state", "ok")
+                    if rank.get(s, 0) > rank[worst]:
+                        worst = s
+        if alive["decode"] == 0:
+            state = "critical"
+        elif (alive["prefill"] == 0
+              or alive["prefill"] < len(self.prefill)
+              or alive["decode"] < len(self.decode)):
+            state = worst if rank[worst] >= 1 else "degraded"
+        else:
+            state = worst
+        return {"state": state, "alive": alive,
+                "pools": pools}
 
 
 def _sleep(seconds: float) -> None:

@@ -44,6 +44,7 @@ from typing import Mapping, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 #: the reserved garbage/scratch page id (never allocated; the page-
 #: table filler for unallocated entries)
@@ -75,6 +76,18 @@ def build_pool(cache_shapes, n_pages: int, page_size: int) -> list:
 
 def pool_nbytes(pages: list) -> int:
     return sum(int(p.nbytes) for p in pages)
+
+
+def leaf_templates(segments) -> list[dict]:
+    """Self-describing ``{"shape", "dtype"}`` descriptors for one KV
+    block's segment leaves — the wire meta the disaggregated handoff
+    ships ahead of the raw page bytes, so the receiver can slice a
+    gather-sent frame back into typed arrays without any per-leaf
+    framing (``serving.pack_kv_blocks`` / ``unpack_kv_blocks``).
+    Every block of one export shares these templates: blocks are
+    ``[1, KVH, page, D]`` slices of the same pool leaves."""
+    return [{"shape": [int(d) for d in np.asarray(s).shape],
+             "dtype": str(np.asarray(s).dtype)} for s in segments]
 
 
 def gather_cache(cache_shapes, pages: list, table):

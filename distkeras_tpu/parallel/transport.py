@@ -266,6 +266,18 @@ WIRE_OPS.register("replica", b"w", "swap_weights")
 WIRE_OPS.register("replica", b"v", "variables")
 WIRE_OPS.register("replica", b"q", "quiesce")
 WIRE_OPS.register("replica", b"s", "stop")
+# disaggregated prefill/decode handoff (ISSUE 19): kv_probe asks how
+# many leading prompt blocks a replica's prefix store already holds,
+# kv_export streams them out, kv_import installs a shipped block set
+WIRE_OPS.register("replica", b"y", "kv_probe")
+WIRE_OPS.register("replica", b"x", "kv_export")
+WIRE_OPS.register("replica", b"k", "kv_import")
+# KV page-block interchange payload (serving.pack_kv_blocks /
+# unpack_kv_blocks): ONE gather-sent frame = the block-set op byte, an
+# 8-byte BE meta length, the msgpack meta (prompt, per-leaf shape/
+# dtype templates), then every block's raw leaf bytes back to back —
+# zero-copy on the send side (page memoryviews ride ``sendmsg``)
+WIRE_OPS.register("kv", b"K", "page_blocks")
 
 
 # -- trace-context wire header (ISSUE 6) -------------------------------

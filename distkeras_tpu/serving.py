@@ -75,10 +75,25 @@ position-causal, the chunk path runs the exact dense cache read) and
 keep the compiled program set bounded; with both off, the legacy
 one-shot prefill path is byte-identical to before.
 
+Disaggregated prefill/decode (ISSUE 19): because prefix-store segments
+and KV pages are the same ``[1, KVH, align, D]`` blocks, a finished
+prefill's cache is a SHIPPABLE currency.  ``export_prefix`` pulls a
+prompt's cached blocks out of the store as host arrays,
+``import_prefix`` installs a shipped block set into another engine's
+store (admission then takes the ordinary prefix-hit path, so the
+decode-side tokens are byte-identical to a monolithic engine by
+construction), and ``match_blocks`` is the cluster-tier lookup —
+check local blocks before asking the prefill pool's store.
+``pack_kv_blocks`` / ``unpack_kv_blocks`` are the wire codec (scope
+``"kv"``, gather-sent page memoryviews behind a length-prefixed
+msgpack meta); ``gateway.PrefillDecodeRouter`` drives the pipeline.
+
 Observability (``distkeras_tpu.telemetry``; no-op until
 ``telemetry.enable()``): per-bucket ``serving_queue_depth`` /
 ``serving_slot_occupancy`` gauges, ``serving_ttft_seconds`` /
-``serving_latency_seconds`` histograms, token/request/finish counters,
+``serving_latency_seconds`` / ``serving_inter_token_seconds``
+histograms (the latter feeds the watchdog's ``inter_token_p99``
+signal), token/request/finish counters,
 trace-time ``compiles_total{kind,bucket[,padded]}`` (the public face
 of ``compile_counts``), and ``prefill``/``decode_step`` spans +
 ``evict`` instants on the serving thread's timeline track.  The
@@ -93,6 +108,7 @@ stamps all read ``telemetry.now()`` — see ``_finish``.
 from __future__ import annotations
 
 import collections
+import struct
 import threading
 from typing import Iterable, Iterator, Mapping, Optional
 
@@ -105,6 +121,7 @@ from distkeras_tpu import speculative as _speculative
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.models.generate import (_decode_model, _select,
                                            decode_step)
+from distkeras_tpu.parallel import transport
 
 _UNSET = object()
 
@@ -126,11 +143,98 @@ def _ceil_to(n: int, align: int) -> int:
     return -(-n // align) * align
 
 
+# ---------------------------------------------------------------------
+# KV page-block wire codec (ISSUE 19, wire scope "kv")
+# ---------------------------------------------------------------------
+#
+# One exported block set travels as ONE transport frame:
+#   b"K" + meta_len(8B BE) + pack_obj(meta) + block0 leaves + block1 ...
+# where meta carries the prompt, the block count, the exporter's
+# weights version, and one shape/dtype template per cache leaf
+# (``paging.leaf_templates`` — every block of an export shares them).
+# The raw leaf bytes carry NO per-part framing: the receiver slices
+# the body by the templates' byte sizes, so the send side can gather-
+# send page memoryviews with zero copies (``transport.send_msg_gather``).
+
+_KV_META_HDR = struct.Struct(">Q")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """``np.dtype`` by name, falling back to the ml_dtypes extension
+    types (bfloat16 et al.) that numpy only knows once registered."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_kv_blocks(export: Mapping) -> list:
+    """Wire parts for one ``export_prefix`` result, ready for
+    ``transport.send_msg_gather`` (or ``b"".join`` for tests).  The
+    leaf arrays ride as memoryviews — no ``tobytes`` copies."""
+    blocks = export.get("blocks") or []
+    meta = {"prompt": np.ascontiguousarray(export["prompt"],
+                                           dtype=np.int32),
+            "n_blocks": int(len(blocks)),
+            "weights_ver": int(export.get("weights_ver", 0)),
+            "leaves": (paging.leaf_templates(blocks[0])
+                       if blocks else [])}
+    mb = transport.pack_obj(meta)
+    parts: list = [b"K", _KV_META_HDR.pack(len(mb)), mb]
+    for segs in blocks:
+        for s in segs:
+            # uint8 view: extension dtypes (bfloat16 et al.) have no
+            # buffer-protocol format, but their bytes ride fine
+            parts.append(np.ascontiguousarray(
+                np.asarray(s)).view(np.uint8).data)
+    return parts
+
+
+def unpack_kv_blocks(body) -> dict:
+    """Inverse of ``pack_kv_blocks`` over a received frame body
+    (bytes or the ``recv_msg_into`` memoryview): returns the export
+    dict with host-array blocks.  Rejects a malformed frame loudly —
+    a desynced stream must not install garbage KV."""
+    body = memoryview(body)
+    if body.nbytes < 1 + _KV_META_HDR.size or bytes(body[:1]) != b"K":
+        raise ValueError("not a kv page_blocks frame")
+    (mlen,) = _KV_META_HDR.unpack(bytes(body[1:1 + _KV_META_HDR.size]))
+    off = 1 + _KV_META_HDR.size
+    if off + mlen > body.nbytes:
+        raise ValueError("kv frame meta overruns the body")
+    meta = transport.unpack_obj(body[off:off + mlen])
+    off += mlen
+    n_blocks = int(meta["n_blocks"])
+    tmpls = [(_np_dtype(t["dtype"]),
+              tuple(int(d) for d in t["shape"])) for t in meta["leaves"]]
+    blocks = []
+    for _ in range(n_blocks):
+        segs = []
+        for dt, shape in tmpls:
+            nb = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            if off + nb > body.nbytes:
+                raise ValueError("kv frame leaf overruns the body")
+            segs.append(np.frombuffer(body[off:off + nb],
+                                      dtype=dt).reshape(shape))
+            off += nb
+        blocks.append(segs)
+    if off != body.nbytes:
+        raise ValueError(
+            f"kv frame length mismatch: parsed {off} of "
+            f"{body.nbytes} bytes")
+    return {"prompt": np.asarray(meta["prompt"], np.int32),
+            "n_blocks": n_blocks,
+            "weights_ver": int(meta["weights_ver"]),
+            "blocks": blocks}
+
+
 class _Request:
     __slots__ = ("rid", "prompt", "max_new", "eos_id", "tokens", "meta",
-                 "submit_order", "t_submit", "t_first", "deadline",
-                 "prefix_path", "weights_ver", "tenant", "priority",
-                 "pages", "swap", "spec_on")
+                 "submit_order", "t_submit", "t_first", "t_last_tok",
+                 "traces_seen",
+                 "deadline", "prefix_path", "weights_ver", "tenant",
+                 "priority", "pages", "swap", "spec_on")
 
     def __init__(self, rid, prompt, max_new, eos_id, meta, submit_order,
                  deadline=None, tenant=None, priority=1):
@@ -143,6 +247,8 @@ class _Request:
         self.submit_order = submit_order
         self.t_submit = telemetry.now()
         self.t_first = None
+        self.t_last_tok = None         # inter-token gap anchor
+        self.traces_seen = -1          # engine trace total at anchor
         # absolute telemetry.now() expiry (None: no deadline)
         self.deadline = (None if deadline is None
                          else self.t_submit + deadline)
@@ -1751,6 +1857,8 @@ class DecodeEngine:
             return [self._finish_error(
                 req, f"prefill_failed: {e!r}", pool.env)]
         req.t_first = req.t_first or telemetry.now()
+        req.t_last_tok = telemetry.now()
+        req.traces_seen = sum(self._traces.values())
         m.counter("serving_tokens_total", bucket=pool.env).inc()
         pool.reqs[slot] = req
         if (len(req.tokens) >= req.max_new
@@ -1891,6 +1999,8 @@ class DecodeEngine:
             return []
         del pool.prefilling[slot]
         req.t_first = telemetry.now()
+        req.t_last_tok = req.t_first
+        req.traces_seen = sum(self._traces.values())
         m.counter("serving_tokens_total", bucket=pool.env).inc()
         if req.max_new == 1 or req.tokens[-1] == req.eos_id:
             return [self._finish(pool, slot)]
@@ -1955,6 +2065,83 @@ class DecodeEngine:
                 "invalidations": s.invalidations,
                 "tokens_saved": s.tokens_saved, "nodes": s.n_nodes,
                 "bytes": s.nbytes, "budget_bytes": s.budget}
+
+    # ---- disaggregated prefill/decode interchange ---------------------
+    #
+    # The store mutators below follow the store's ownership discipline:
+    # call them from the stepping thread only (the gateway replica
+    # serializes them through its command mailbox, which IS the
+    # stepping thread).
+
+    def match_blocks(self, prompt) -> int:
+        """How many leading whole ``prefill_align`` blocks of
+        ``prompt`` the local prefix store already holds — the cluster-
+        tier probe a decode-side router runs before asking the prefill
+        pool's store (and before recomputing)."""
+        if self._prefix is None:
+            return 0
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        return len(self._prefix.match(
+            prompt, len(prompt) // self.prefill_align))
+
+    def export_prefix(self, prompt) -> Optional[dict]:
+        """Pull ``prompt``'s cached prefix blocks out of the store as
+        HOST arrays — the prefill side of the disaggregated handoff.
+        Returns ``{"prompt", "n_blocks", "weights_ver", "blocks"}``
+        (``blocks[b]`` = block ``b``'s segment leaves, outermost
+        first in cache-flatten order) or ``None`` when nothing is
+        cached.  Pairs with ``pack_kv_blocks`` for the wire."""
+        if self._prefix is None:
+            return None
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        path = self._prefix.match(
+            prompt, len(prompt) // self.prefill_align)
+        if not path:
+            return None
+        blocks = [[np.asarray(jax.device_get(s)) for s in n.segments]
+                  for n in path]
+        return {"prompt": prompt, "n_blocks": len(blocks),
+                "weights_ver": self._weights_ver, "blocks": blocks}
+
+    def import_prefix(self, prompt, blocks,
+                      weights_ver: Optional[int] = None) -> int:
+        """Install a shipped block set into the local prefix store —
+        the decode side of the handoff.  Admission then takes the
+        ordinary prefix-hit path (device copy + tail prefill), which
+        existing parity tests pin byte-identical to a monolithic
+        engine, so imported KV changes WHERE prefill ran, never what
+        tokens come out.  Returns the number of blocks newly
+        installed (already-cached blocks are touched, not
+        duplicated).  A ``weights_ver`` that does not match the local
+        engine's is a STALE export — rejected whole (return 0): KV
+        under different weights is silently wrong."""
+        if self._prefix is None or not blocks:
+            return 0
+        if weights_ver is not None and weights_ver != self._weights_ver:
+            return 0
+        store = self._prefix
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        align = self.prefill_align
+        installed = 0
+        node = store.root
+        for b, segs in enumerate(blocks):
+            key = prompt[b * align:(b + 1) * align].tobytes()
+            if len(key) < align * 4:
+                break  # ragged tail: never index a partial block
+            child = node.children.get(key)
+            if child is None:
+                child = store.insert(
+                    node, key, [jnp.asarray(s) for s in segs])
+                installed += 1
+            else:
+                store._touch(child)
+            node = child
+        if installed:
+            evicted = store.evict_to_budget()
+            if evicted:
+                telemetry.metrics().counter(
+                    "serving_prefix_evictions_total").inc(evicted)
+        return installed
 
     def _finish(self, pool: _Pool, slot: int) -> dict:
         """Evict the finished request and assemble its result dict.
@@ -2038,6 +2225,31 @@ class DecodeEngine:
                 "t_finish": t_finish, "ttft": ttft,
                 "latency": t_finish - req.t_submit}
 
+    def _note_inter_token(self, req: _Request, n: int) -> None:
+        """Observe the decode-side inter-token gap for ``n`` freshly
+        committed tokens: elapsed time since the request's previous
+        token, spread evenly over the batch (speculative commits land
+        several tokens from one program).  Feeds
+        ``serving_inter_token_seconds`` — the histogram behind the
+        ``inter_token_p99`` SLO signal and the disaggregation A/B's
+        flood-flatness gate."""
+        if n <= 0:
+            return
+        t_now = telemetry.now()
+        # a gap that spans a program trace is a compile stall (cold
+        # engine, new shape), not decode cadence — recording it would
+        # flip a freshly built engine's SLO verdict critical and make
+        # rolling_update's health gate roll back a healthy swap
+        traces = sum(self._traces.values())
+        if req.t_last_tok is not None and traces == req.traces_seen:
+            gap = (t_now - req.t_last_tok) / n
+            h = telemetry.metrics().histogram(
+                "serving_inter_token_seconds")
+            for _ in range(n):
+                h.observe(gap)
+        req.t_last_tok = t_now
+        req.traces_seen = traces
+
     # ---- speculative decode -------------------------------------------
 
     def _commit_tokens(self, req: _Request,
@@ -2049,13 +2261,16 @@ class DecodeEngine:
         step loop applies per step.  Returns ``(committed,
         finished)``."""
         c = 0
+        fin = False
         for t in cand:
             req.tokens.append(int(t))
             c += 1
             if (len(req.tokens) >= req.max_new
                     or req.tokens[-1] == req.eos_id):
-                return c, True
-        return c, False
+                fin = True
+                break
+        self._note_inter_token(req, c)
+        return c, fin
 
     def _spec_grow(self, pool: _Pool, slot: int, req: _Request,
                    start: int, width: int) -> bool:
@@ -2351,15 +2566,22 @@ class DecodeEngine:
                 for slot, req in enumerate(pool.reqs):
                     if req is None:
                         continue
+                    got = 0
+                    fin = False
                     for k in range(toks.shape[0]):
                         if was_done[k, slot]:
                             break
                         req.tokens.append(int(toks[k, slot]))
-                        n_tok += 1
+                        got += 1
                         if (len(req.tokens) >= req.max_new
                                 or req.tokens[-1] == req.eos_id):
-                            finished.append(self._finish(pool, slot))
+                            fin = True
                             break
+                    if got:
+                        self._note_inter_token(req, got)
+                        n_tok += got
+                    if fin:
+                        finished.append(self._finish(pool, slot))
                 if n_tok:
                     m.counter("serving_tokens_total",
                               bucket=pool.env).inc(n_tok)

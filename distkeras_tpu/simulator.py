@@ -62,6 +62,12 @@ __all__ = [
 #: declared p99 is ``median * exp(sigma * Z99)``
 _Z99 = 2.3263478740408408
 
+#: per-tenant length models a ``TraceSpec`` tenant quad may select
+#: (the optional 4th tuple element); ``prefill_heavy`` is the long-
+#: prompt / short-output flood class the disaggregated serving drill
+#: generates natively
+TENANT_CLASSES = ("default", "prefill_heavy")
+
 
 # ---------------------------------------------------------------------
 # trace specification + generation
@@ -92,8 +98,17 @@ class TraceSpec:
     groups sharing a ``prefix_len``-token system prefix (the
     prefix-cache workload shape).
 
-    Tenants: ``(name, share, priority)`` triples; shares are
-    normalized, priority rides into the engine QoS scheduler (0..2).
+    Tenants: ``(name, share, priority)`` triples — or ``(name, share,
+    priority, tenant_class)`` quads — with shares normalized and
+    priority riding into the engine QoS scheduler (0..2).  The
+    optional class picks the tenant's length model: ``"default"``
+    uses the spec-wide prompt/output models above;
+    ``"prefill_heavy"`` draws long lognormal prompts
+    (``heavy_prompt_median`` / ``heavy_prompt_sigma``) with outputs
+    clipped to ``heavy_output_max`` — the prefill-flood workload the
+    disaggregated serving drill rides on.  Heavy arrivals take their
+    EXTRA length draws after the tenant draw, so a spec without
+    heavy tenants generates a byte-identical trace per seed.
     """
 
     duration_s: float
@@ -115,6 +130,9 @@ class TraceSpec:
     prefix_groups: int = 4
     prefix_len: int = 2
     tenants: tuple = (("default", 1.0, 1),)
+    heavy_prompt_median: float = 192.0
+    heavy_prompt_sigma: float = 0.35
+    heavy_output_max: int = 16
 
     def __post_init__(self):
         if self.duration_s <= 0 or self.mean_qps <= 0:
@@ -142,8 +160,25 @@ class TraceSpec:
             raise ValueError("session_zipf must be > 1")
         if self.sessions < 1 or self.prefix_groups < 1:
             raise ValueError("need sessions >= 1, prefix_groups >= 1")
-        if not self.tenants or any(s <= 0 for _, s, _ in self.tenants):
+        if not self.tenants:
             raise ValueError("tenants need positive shares")
+        for ten in self.tenants:
+            if len(ten) not in (3, 4):
+                raise ValueError(
+                    f"tenant {ten!r} must be (name, share, priority) "
+                    f"or (name, share, priority, tenant_class)")
+            if ten[1] <= 0:
+                raise ValueError("tenants need positive shares")
+            if len(ten) == 4 and ten[3] not in TENANT_CLASSES:
+                raise ValueError(
+                    f"unknown tenant class {ten[3]!r}; choose from "
+                    f"{TENANT_CLASSES}")
+        if self.heavy_prompt_median < 1:
+            raise ValueError("heavy_prompt_median must be >= 1")
+        if self.heavy_output_max < self.output_min:
+            raise ValueError(
+                f"heavy_output_max={self.heavy_output_max} below "
+                f"output_min={self.output_min}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,7 +250,7 @@ def generate_trace(spec: TraceSpec) -> Trace:
                             size=(spec.prefix_groups, spec.prefix_len))
     session_group = rng.integers(0, spec.prefix_groups,
                                  size=spec.sessions)
-    shares = np.array([s for _, s, _ in spec.tenants], float)
+    shares = np.array([ten[1] for ten in spec.tenants], float)
     cum = np.cumsum(shares / shares.sum())
     peak = peak_rate(spec)
     arrivals = []
@@ -239,11 +274,24 @@ def generate_trace(spec: TraceSpec) -> Trace:
         ti = int(np.searchsorted(cum, float(rng.random()),
                                  side="right"))
         ti = min(ti, len(spec.tenants) - 1)
+        ten = spec.tenants[ti]
+        if len(ten) == 4 and ten[3] == "prefill_heavy":
+            # heavy-class REDRAW: two extra rng values consumed only
+            # on heavy arrivals, so a spec without heavy tenants
+            # replays byte-identically under the same seed
+            plen = int(np.clip(
+                round(spec.heavy_prompt_median * math.exp(float(
+                    rng.normal(0.0, spec.heavy_prompt_sigma)))),
+                spec.prompt_min, spec.prompt_max))
+            nnew = int(np.clip(
+                round(spec.output_min * (1.0 + float(rng.pareto(
+                    spec.output_alpha)))),
+                spec.output_min, spec.heavy_output_max))
         tail = rng.integers(0, spec.vocab,
                             size=plen - spec.prefix_len)
         prompt = np.concatenate(
             [prefixes[int(session_group[sess])], tail]).astype(np.int32)
-        name, _, prio = spec.tenants[ti]
+        name, prio = ten[0], ten[2]
         arrivals.append(Arrival(t=t, prompt=prompt, max_new=nnew,
                                 session=f"s{sess}", tenant=str(name),
                                 priority=int(prio)))

@@ -1037,6 +1037,8 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "shed_rate": (0.05, 0.25),            # sheds per submitted request
     "queue_depth": (64.0, 256.0),         # queued requests, all buckets
     "ttft_p95_s": (1.0, 10.0),            # seconds to first token
+    "ttft_p99": (2.0, 20.0),              # tail seconds to first token
+    "inter_token_p99": (0.25, 2.5),       # tail decode gap, seconds
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
     "ps_lock_wait": (0.005, 0.05),        # lock-wait s / shard commit
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
@@ -1080,7 +1082,8 @@ class SLOWatchdog:
     """Declarative health evaluator over a ``MetricsRegistry``.
 
     The signals (PS staleness p99, client retry rate, serving shed
-    rate, queue depth, TTFT p95, idle-worker fraction, gateway
+    rate, queue depth, TTFT p95/p99, inter-token p99, idle-worker
+    fraction, gateway
     failover rate, prefix hit rate, PS standby replication lag,
     KV-page preemption rate, speculative accept rate, mesh-round MFU
     gap) are computed
@@ -1169,6 +1172,16 @@ class SLOWatchdog:
         p95 = _merged_percentile(r, "serving_ttft_seconds", 0.95)
         if p95 is not None:
             out["ttft_p95_s"] = p95
+        tp99 = _merged_percentile(r, "serving_ttft_seconds", 0.99)
+        if tp99 is not None:
+            out["ttft_p99"] = tp99
+        # decode-cadence tail: the disaggregation drill's headline —
+        # a prefill flood on a monolithic fleet shows up here first,
+        # while TTFT alone can look healthy
+        itp99 = _merged_percentile(r, "serving_inter_token_seconds",
+                                   0.99)
+        if itp99 is not None:
+            out["inter_token_p99"] = itp99
         registered = sum(m.value for _, m
                          in r.collect("ps_registered_workers"))
         if registered > 0:

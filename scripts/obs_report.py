@@ -150,6 +150,25 @@ def smoke_run(out_dir: str) -> tuple[str, str]:
             for t, n in zip([5, 9, 3, 14, 7, 4], [6, 3, 5, 4, 2, 6])]
     list(eng.run(reqs))
 
+    # (1b) one disaggregated prefill->decode handoff, so the report
+    # surfaces the handoff counters (serving_kv_pages_shipped_total,
+    # serving_handoff_requeue_total — the latter pre-touched at 0)
+    from distkeras_tpu.gateway import EngineReplica, PrefillDecodeRouter
+
+    def _pd_engine():
+        return DecodeEngine(model, variables, slots=2, prefill_align=4,
+                            max_new_tokens=6,
+                            prefix_cache_bytes=1 << 22)
+
+    with PrefillDecodeRouter(
+            [EngineReplica(_pd_engine(), name="obs-p0")],
+            [EngineReplica(_pd_engine(), name="obs-d0")],
+            block_size=4) as router:
+        rid = router.submit(rng.integers(0, 61, (12,)).astype(np.int32),
+                            max_new_tokens=3)
+        res = router.result(rid, timeout=120)
+        assert res.get("error") is None, res
+
     # (2) async host-PS training over the real socket transport
     mlp = model_config("mlp", (8,), num_classes=4, hidden=(16,))
     data = datasets.synthetic_classification(512, (8,), 4, seed=0)
@@ -198,7 +217,10 @@ def main():
         for needle in ("serving_ttft_seconds", "serving_queue_depth",
                        "serving_slot_occupancy", "compiles_total",
                        "ps_commits_total", "ps_commit",
-                       "worker_round", "ps_wire_bytes_total"):
+                       "worker_round", "ps_wire_bytes_total",
+                       "serving_inter_token_seconds",
+                       "serving_kv_pages_shipped_total",
+                       "serving_handoff_requeue_total"):
             assert needle in report, f"report lacks {needle}:\n{report}"
         trace = json.load(open(args.trace))
         commit_tids = {e["tid"] for e in trace["traceEvents"]

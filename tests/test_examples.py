@@ -35,6 +35,7 @@ SMOKE_SCRIPTS = {
     "perf_host_ps.py": ["--smoke"],
     "perf_mesh_comm.py": ["--smoke"],
     "perf_paging.py": ["--smoke"],
+    "perf_prefill_decode.py": ["--smoke"],
     "perf_prefix.py": ["--smoke"],
     "perf_ps_flagship.py": ["--smoke"],
     "perf_regress.py": ["--smoke"],
