@@ -38,6 +38,7 @@ WIRE_SCOPES = {
     "distkeras_tpu/parallel/sharded_ps.py": "ps",
     "distkeras_tpu/parallel/replicated_ps.py": "repl",
     "distkeras_tpu/parallel/elastic_ps.py": "elastic",
+    "distkeras_tpu/parallel/hier_ps.py": "hier",
     "distkeras_tpu/gateway.py": "replica",
     "distkeras_tpu/serving.py": "kv",
     "distkeras_tpu/parallel/transport.py": "frame",

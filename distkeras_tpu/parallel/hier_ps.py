@@ -1,0 +1,483 @@
+"""Hierarchical two-level PS aggregation (ISSUE 20, ROADMAP item 4a).
+
+The flat socket PS serializes every worker's push on ONE commit path;
+PERF.md §25 measured the single-mutex server *degrading* as workers
+grow, and the sharded PS only spreads — never shrinks — the fan-in.
+This module adds the tree-aggregation shape every production PS stack
+converges on: leaf groups of G workers commit to a local
+:class:`GroupLeader`, which folds their delta payloads over an
+``aggregate_window`` with the rule's own closed-form server law and
+forwards ONE pre-reduced upstream commit per window, so the root pays
+O(groups) commits per round instead of O(workers).
+
+Fold law.  The leader keeps a zero-initialized accumulator and applies
+each worker commit with the rule's OWN ``commit`` against that
+accumulator as the center::
+
+    fold <- rule.commit(PSState(center=fold, clock), payload, staleness)
+
+For the delta family this is exactly ``fold += scale(staleness) *
+payload`` (scale = 1 for DOWNPOUR/ADAG, ``1/(staleness+1)`` for
+DynSGD), so the root's plain ``center += fold`` reproduces the flat
+server's arithmetic; the per-worker staleness vector rides the
+upstream frame so the root's staleness bookkeeping (log + histogram)
+stays faithful.  Staleness is leader-local: the leader's commit clock
+minus the worker's last pull clock at the leader — the same law the
+flat server applies, evaluated where the contention actually is.
+Floating-point reassociation caveat: the fold reassociates the round's
+additions like any tree reduction; byte-identity with the flat
+topology holds whenever the payload sums are exact (the parity tests
+use dyadic-rational payloads), and to ~1 ulp otherwise.
+
+Durability contract.  A leader's ack means the commit is FOLDED, not
+yet durable at the root: at most ``aggregate_window - 1`` acked
+commits ride in the open window and die with a crashed leader (the
+degraded-not-down tradeoff; set ``aggregate_window=1`` for flat-PS
+durability at flat-PS fan-in).  The leader's own upstream retry is
+exactly-once: the root dedupes per-leader upstream seqs
+(``commit_group``), so a lost-ack resend never double-applies a
+window.  Leader death is handled client-side: :class:`LeaderRoute`
+fails workers over to direct-to-root mode (``leader_down`` /
+``leader_rejoin`` flight kinds, ``ps_leader_failovers_total``).
+
+Wire.  One new ``"hier"``-scope op on the existing ``transport``
+framing, gather-sent (no join copy)::
+
+    upstream_commit := op + seq(8B BE) + n(2B BE)
+                       + n * (worker_id(4B BE) + staleness(4B BE))
+                       + pack_params(fold)          -> pack_params(center)
+
+Leaders identify themselves on the root hello with worker ids from
+``HIER_LEADER_BASE + group_id`` — a distinct id space, so root-side
+dedupe keyed by leader can never collide with a real worker's seqs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from distkeras_tpu import flight_recorder, telemetry
+from distkeras_tpu.analysis import racecheck
+from distkeras_tpu.parallel import transport
+from distkeras_tpu.parallel.host_ps import (
+    _NO_SEQ,
+    _readonly_tree,
+    _ReplicaCycler,
+    _to_numpy,
+    pack_params,
+    PSClient,
+    PSServer,
+    ResilientPSClient,
+    unpack_params,
+)
+from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
+from distkeras_tpu.utils import tree_add, tree_zeros_like
+
+Pytree = Any
+
+#: leader hello ids start here: far above any worker id, below the
+#: reserved probe id (2**32 - 1), so root-side per-leader dedupe and
+#: liveness bookkeeping can never collide with a real worker's.
+HIER_LEADER_BASE = 2 ** 31
+
+# the one "hier"-scope wire op (registered in transport.WIRE_OPS)
+_OP_UPSTREAM = b"u"
+
+
+class HierPSServer(PSServer):
+    """Root-side TCP front end: the classic PS protocol plus the
+    ``upstream_commit`` op, dispatched to ``ps.commit_group`` (both
+    ``HostParameterServer`` and ``ShardedParameterServer`` implement
+    it).  Direct-to-root workers keep speaking the classic verbs on
+    the same port — the degraded mode after a leader death."""
+
+    def _dispatch(self, conn, worker_id, codec, cmd, body, rx, tx):
+        if cmd == _OP_UPSTREAM:
+            seq = int.from_bytes(body[:8], "big")
+            if seq == _NO_SEQ:
+                seq = None
+            n = int.from_bytes(body[8:10], "big")
+            off = 10
+            workers, staleness = [], []
+            for _ in range(n):
+                workers.append(int.from_bytes(body[off:off + 4],
+                                              "big"))
+                staleness.append(int.from_bytes(body[off + 4:off + 8],
+                                                "big"))
+                off += 8
+            fold = unpack_params(self._template, body[off:])
+            pulled = self.ps.commit_group(worker_id, fold, staleness,
+                                          workers, seq=seq)
+            wire = pack_params(pulled, self._template)
+            tx.inc(len(wire))
+            transport.send_msg(conn, wire)
+        else:
+            super()._dispatch(conn, worker_id, codec, cmd, body, rx,
+                              tx)
+
+
+class _UpstreamLink:
+    """The leader's single connection to the root ``HierPSServer``:
+    lazy connect, bounded reconnect-and-resend retry.  A resend reuses
+    the SAME upstream seq, so a window whose *ack* was lost dedupes at
+    the root instead of applying twice (exactly-once end to end)."""
+
+    def __init__(self, host: str, port: int, leader_id: int,
+                 template: Pytree, *, retries: int = 10,
+                 backoff: float = 0.05):
+        self._addr = (str(host), int(port))
+        self._leader_id = int(leader_id)
+        self._template = template
+        self._retries = int(retries)
+        self._backoff = float(backoff)
+        self._sock = None
+
+    def _connect(self):
+        self._sock = transport.connect(*self._addr, timeout=30.0)
+        transport.send_msg(
+            self._sock, int(self._leader_id).to_bytes(4, "big"))
+
+    def exchange(self, seq: int, constituents, fold_packed: bytes
+                 ) -> Pytree:
+        """Send one upstream window, return the root's new center."""
+        head = (_OP_UPSTREAM + int(seq).to_bytes(8, "big")
+                + len(constituents).to_bytes(2, "big")
+                + b"".join(int(w).to_bytes(4, "big")
+                           + int(s).to_bytes(4, "big")
+                           for w, s in constituents))
+        last: Exception | None = None
+        for attempt in range(self._retries + 1):
+            try:
+                if self._sock is None:
+                    self._connect()
+                transport.send_msg_gather(self._sock, head,
+                                          fold_packed)
+                reply = transport.recv_msg(self._sock)
+                return unpack_params(self._template, reply)
+            except (ConnectionError, OSError) as e:
+                last = e
+                self.close()
+                if attempt < self._retries:
+                    time.sleep(self._backoff * (attempt + 1))
+        raise ConnectionError(
+            f"upstream commit seq={seq} failed after "
+            f"{self._retries + 1} attempts against "
+            f"{self._addr}") from last
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class GroupLeader:
+    """One aggregation-tier node: fronts G workers with the SAME
+    server face (and ``PSServer`` wire) as a flat PS, but commits land
+    in a window accumulator instead of a center; every
+    ``aggregate_window``-th commit (or, with ``flush_interval``, a
+    clock-based timeout on a partial window) flushes ONE pre-reduced
+    upstream commit to the root and adopts the returned center as the
+    new local mirror.
+
+    Workers pull ``mirror + fold`` — the freshest center view this
+    leader can serve without a root round trip; commit replies are the
+    same local ack, which is where the throughput win comes from
+    (G - 1 of every G commits never wait on the root).
+
+    Delta family only: a params-kind payload (elastic rules) has no
+    meaningful sum, so construction rejects it."""
+
+    def __init__(self, rule: UpdateRule, template: Pytree,
+                 upstream: tuple[str, int], *, group_id: int = 0,
+                 aggregate_window: int = 1,
+                 flush_interval: float | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 upstream_retries: int = 10):
+        if rule.payload_kind != "delta":
+            raise ValueError(
+                f"hierarchical aggregation needs a delta-family rule; "
+                f"{type(rule).__name__} commits "
+                f"{rule.payload_kind!r} payloads")
+        if int(aggregate_window) < 1:
+            raise ValueError(
+                f"aggregate_window must be >= 1, got "
+                f"{aggregate_window}")
+        self.rule = rule
+        self.group_id = int(group_id)
+        self.leader_id = HIER_LEADER_BASE + self.group_id
+        self.aggregate_window = int(aggregate_window)
+        self.flush_interval = (None if flush_interval is None
+                               else float(flush_interval))
+        self._template = _to_numpy(template)
+        self._upstream = _UpstreamLink(
+            upstream[0], upstream[1], self.leader_id, self._template,
+            retries=upstream_retries)
+        self._lock = racecheck.lock("hier_leader")
+        # serializes upstream flushes: seqs are assigned AND sent under
+        # this lock, so the root never sees seq k+1 before k (its
+        # dedupe would otherwise drop the late window as a duplicate)
+        self._flush_lock = racecheck.lock("hier_leader.flush")
+        self._mirror = _to_numpy(template)  # guarded-by: _lock
+        self._fold = tree_zeros_like(self._template)  # guarded-by: _lock
+        self._constituents: list[tuple[int, int]] = []
+        self._window_opened: float | None = None  # guarded-by: _lock
+        self._clock = 0  # guarded-by: _lock
+        self._pull_clock: dict[int, int] = {}
+        self._last_seen: dict[int, float] = {}
+        self._last_reply: dict[int, tuple[int, bytes]] = {}
+        self._up_seq = 0  # guarded-by: _flush_lock
+        self.num_commits = 0
+        self.num_upstream = 0
+        self.epoch = 0
+        self.server = PSServer(self, self._template, host=host,
+                               port=port)
+        self._stop_timer = threading.Event()
+        self._timer: threading.Thread | None = None
+        if self.flush_interval is not None:
+            self._timer = threading.Thread(target=self._timer_loop,
+                                           daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server.address
+
+    def start(self) -> "GroupLeader":
+        self.server.start()
+        if self._timer is not None:
+            self._timer.start()
+        return self
+
+    def stop(self):
+        """Plain teardown (no flush — call ``drain()`` first if the
+        open window must reach the root)."""
+        self._stop_timer.set()
+        if self._timer is not None:
+            self._timer.join()
+        self.server.stop()
+        self._upstream.close()
+
+    def kill(self):
+        """Crash simulation: drop the worker-facing sockets AND the
+        upstream link mid-window — the open window's folded commits
+        die with the leader (the documented durability tradeoff);
+        workers see ``ConnectionError`` and fail over to the root."""
+        self._stop_timer.set()
+        self.server.kill()
+        self._upstream.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- the server face PSServer dispatches against -----------------------
+
+    def pull(self, worker_id: int) -> Pytree:
+        telemetry.metrics().counter("ps_pulls_total").inc()
+        with self._lock:
+            self._pull_clock[worker_id] = self._clock
+            self._last_seen[worker_id] = telemetry.now()
+            return _readonly_tree(
+                _to_numpy(tree_add(self._mirror, self._fold)))
+
+    def commit(self, worker_id: int, payload: Pytree,
+               local: Pytree | None = None,
+               seq: int | None = None) -> Pytree:
+        """Fold one worker commit into the open window and ack
+        locally; the commit that fills the window carries the flush
+        (synchronously, outside the state lock) before returning."""
+        del local  # delta family only — pull law never reads it
+        payload = _to_numpy(payload)
+        m = telemetry.metrics()
+        flush_out = None
+        with self._lock:
+            if seq is not None:
+                last = self._last_reply.get(worker_id)
+                if last is not None and seq <= last[0]:
+                    self._last_seen[worker_id] = telemetry.now()
+                    m.counter("ps_commit_dedup_total").inc()
+                    # lint: allow(blocking-call-under-lock): acked =>
+                    # recorded, same contract as the flat server
+                    flight_recorder.record("commit_dedup",
+                                           worker=worker_id, seq=seq)
+                    return unpack_params(self._template, last[1])
+            staleness = self._clock - self._pull_clock.get(worker_id,
+                                                          0)
+            state = PSState(center=self._fold,
+                            clock=np.int32(self._clock))
+            self._fold = _to_numpy(self.rule.commit(
+                state, payload, np.int32(staleness)).center)
+            self._clock += 1
+            self._pull_clock[worker_id] = self._clock
+            if not self._constituents:
+                self._window_opened = telemetry.now()
+            self._constituents.append((int(worker_id),
+                                       int(staleness)))
+            self.num_commits += 1
+            self._last_seen[worker_id] = telemetry.now()
+            pulled = _to_numpy(tree_add(self._mirror, self._fold))
+            if seq is not None:
+                self._last_reply[worker_id] = (seq,
+                                               pack_params(pulled))
+            if len(self._constituents) >= self.aggregate_window:
+                flush_out = (self._fold, self._constituents)
+                self._fold = tree_zeros_like(self._template)
+                self._constituents = []
+                self._window_opened = None
+        if flush_out is not None:
+            self._flush(*flush_out)
+        return _readonly_tree(pulled)
+
+    def register(self, worker_id: int) -> None:
+        with self._lock:
+            self._last_seen.setdefault(worker_id, telemetry.now())
+
+    def retire(self, worker_id: int) -> None:
+        with self._lock:
+            self._last_seen.pop(worker_id, None)
+            self._last_reply.pop(worker_id, None)
+
+    def idle_workers(self, timeout: float) -> list[int]:
+        now = telemetry.now()
+        with self._lock:
+            return sorted(w for w, seen in self._last_seen.items()
+                          if now - seen > timeout)
+
+    def clear_reply_cache(self) -> None:
+        with self._lock:
+            self._last_reply.clear()
+
+    @property
+    def center(self) -> Pytree:
+        """The leader's center view: mirror + open fold."""
+        with self._lock:
+            return _readonly_tree(
+                _to_numpy(tree_add(self._mirror, self._fold)))
+
+    # -- upstream ----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Flush any open partial window and wait until every
+        in-flight upstream exchange has been acked by the root — after
+        this returns, every folded commit is durable upstream (called
+        before final-center reads and clean shutdown)."""
+        with self._lock:
+            flush_out = None
+            if self._constituents:
+                flush_out = (self._fold, self._constituents)
+                self._fold = tree_zeros_like(self._template)
+                self._constituents = []
+                self._window_opened = None
+        if flush_out is not None:
+            self._flush(*flush_out)
+        else:
+            with self._flush_lock:
+                pass  # barrier: an in-flight flush holds this lock
+
+    def _flush(self, fold: Pytree, constituents) -> None:
+        with self._flush_lock:
+            seq = self._up_seq
+            self._up_seq += 1
+            with telemetry.span("hier_aggregate",
+                                group=self.group_id, seq=seq,
+                                fanin=len(constituents)):
+                packed = pack_params(fold, self._template)
+                center = self._upstream.exchange(seq, constituents,
+                                                 packed)
+            with self._lock:
+                self._mirror = _to_numpy(center)
+                self.num_upstream += 1
+
+    def _timer_loop(self):
+        poll = max(self.flush_interval / 4, 0.001)
+        while not self._stop_timer.wait(poll):
+            flush_out = None
+            with self._lock:
+                opened = self._window_opened
+                if (self._constituents and opened is not None
+                        and telemetry.now() - opened
+                        >= self.flush_interval):
+                    flush_out = (self._fold, self._constituents)
+                    self._fold = tree_zeros_like(self._template)
+                    self._constituents = []
+                    self._window_opened = None
+            if flush_out is not None:
+                try:
+                    self._flush(*flush_out)
+                except (ConnectionError, OSError):
+                    return  # root gone: the drain/stop path reports it
+
+
+class LeaderRoute(_ReplicaCycler):
+    """Two-address failover route: the group's leader first, the root
+    as the degraded fallback.  Advancing off the leader records a
+    ``leader_down`` flight event and bumps
+    ``ps_leader_failovers_total`` (the ``leader_failover_rate`` SLO's
+    numerator); a later successful build back at the leader address
+    records ``leader_rejoin``.  Probe-before-advance is inherited: a
+    chaos-injected transient on a healthy leader retries in place
+    instead of stampeding the root."""
+
+    def __init__(self, leader: tuple[str, int], root: tuple[str, int],
+                 *, worker: int | None = None,
+                 probe_timeout: float = 0.25):
+        super().__init__([leader, root], worker=worker,
+                         probe_timeout=probe_timeout)
+        self._degraded = False  # guarded-by: _lock
+
+    def connect(self, build: Callable[[str, int], Any]):
+        try:
+            client = super().connect(build)
+        except Exception:
+            with self._lock:
+                went_down = self._i == 1 and not self._degraded
+                if went_down:
+                    self._degraded = True
+            if went_down:
+                telemetry.metrics().counter(
+                    "ps_leader_failovers_total").inc()
+                flight_recorder.record(
+                    "leader_down", worker=self.worker,
+                    leader_port=self.addresses[0][1])
+            raise
+        with self._lock:
+            rejoined = self._degraded and self._i == 0
+            if rejoined:
+                self._degraded = False
+        if rejoined:
+            flight_recorder.record(
+                "leader_rejoin", worker=self.worker,
+                leader_port=self.addresses[0][1])
+        return client
+
+
+def resilient_hier_client(leader: tuple[str, int],
+                          root: tuple[str, int], *, worker_id: int,
+                          template: Pytree, codec=None,
+                          **kw) -> ResilientPSClient:
+    """A grouped worker's client: ``ResilientPSClient`` over a
+    :class:`LeaderRoute`, so a dead leader degrades the worker to
+    direct-to-root mode within one retry (and back, when the route
+    wraps to a revived leader).  The route rides on ``.replicas`` —
+    the same attribute ``for_replicas`` uses — so callers fold
+    ``.failovers`` into history identically."""
+    route = LeaderRoute(leader, root, worker=worker_id)
+
+    def factory():
+        return route.connect(
+            lambda h, p: PSClient(h, p, worker_id, template,
+                                  codec=codec))
+
+    client = ResilientPSClient(factory, worker=worker_id, **kw)
+    client.replicas = route
+    return client

@@ -44,6 +44,7 @@ from distkeras_tpu import flight_recorder, telemetry
 from distkeras_tpu.analysis import racecheck
 from distkeras_tpu.parallel import transport
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
+from distkeras_tpu.utils import tree_add
 
 def _to_numpy(tree: Pytree) -> Pytree:
     return jax.tree_util.tree_map(np.asarray, tree)
@@ -331,6 +332,90 @@ class HostParameterServer:
                 if last is not None and last[0] == seq:
                     return last[1]
         return pack_params(pulled)
+
+    def commit_group(self, leader_id: int, fold: Pytree,
+                     staleness, workers,
+                     seq: int | None = None) -> Pytree:
+        """Apply one pre-reduced group window from a ``hier_ps``
+        leader: ``fold`` is the sum of ``len(workers)`` already-scaled
+        delta commits (the leader ran the rule's server law per
+        constituent), so the root applies it with a plain
+        ``center += fold`` and advances its clock by the constituent
+        count.  ``staleness`` is the per-worker staleness vector the
+        leader measured — logged and histogrammed here so the root's
+        staleness record stays faithful to what the rule actually
+        scaled by.
+
+        ``seq`` dedupes per LEADER (leader ids live in their own
+        ``HIER_LEADER_BASE`` space): a lost-ack upstream retry gets
+        the cached center back instead of double-applying the window —
+        exactly-once end to end.  Returns the new center (the leader's
+        next mirror)."""
+        if self.rule.payload_kind != "delta":
+            raise ValueError(
+                f"hierarchical aggregation needs a delta-family "
+                f"rule; {type(self.rule).__name__} commits "
+                f"{self.rule.payload_kind!r} payloads")
+        fold = _to_numpy(fold)
+        n = len(workers)
+        staleness = [int(s) for s in staleness]
+        m = telemetry.metrics()
+        with telemetry.span("ps_commit", worker=leader_id,
+                            fanin=n), self._lock:
+            if self._fenced:
+                raise PSFencedError(
+                    f"commit rejected: this server was deposed (a "
+                    f"newer primary holds epoch > {self.epoch})")
+            if self._replicator is not None:
+                raise RuntimeError(
+                    "hierarchical upstream commits do not compose "
+                    "with primary/standby replication (the standby "
+                    "replay re-runs the rule's single-commit law, "
+                    "not the group fold)")
+            if seq is not None:
+                last = self._last_reply.get(leader_id)
+                if last is not None and seq <= last[0]:
+                    self._last_seen[leader_id] = telemetry.now()
+                    m.counter("ps_commit_dedup_total").inc()
+                    # lint: allow(blocking-call-under-lock): the dedup
+                    # decision must hit the flight log before the
+                    # cached reply escapes (acked => recorded)
+                    flight_recorder.record("commit_dedup",
+                                           worker=leader_id, seq=seq)
+                    return unpack_params(self._center, last[1])
+            self._center = _to_numpy(tree_add(self._center, fold))
+            self._clock += n
+            self._pull_clock[leader_id] = self._clock
+            self.staleness_log.extend(staleness)
+            if len(self.staleness_log) > \
+                    self.STALENESS_LOG_WINDOW * 5 // 4:
+                del self.staleness_log[:-self.STALENESS_LOG_WINDOW]
+            before = self.num_commits
+            self.num_commits += n
+            self._last_seen[leader_id] = telemetry.now()
+            m.counter("ps_commits_total").inc(n)
+            m.counter("ps_upstream_commits_total").inc()
+            m.gauge("ps_fanin_reduction").set(n)
+            hist = m.histogram("ps_commit_staleness",
+                               buckets=telemetry.STALENESS_BUCKETS)
+            for s in staleness:
+                hist.observe(s)
+            # lint: allow(blocking-call-under-lock): acked => durable,
+            # same contract as the single-commit path
+            flight_recorder.record(
+                "commit", worker=leader_id, seq=seq,
+                clock=self._clock, fanin=n,
+                staleness=max(staleness, default=0))
+            if seq is not None:
+                self._cache_reply_locked(leader_id, seq,
+                                         pack_params(self._center))
+            if (self._snapshot_every
+                    and self.num_commits // self._snapshot_every
+                    > before // self._snapshot_every):
+                # the clock jumps by n; snapshot on every crossed
+                # boundary, not just exact multiples
+                self._write_snapshot_locked()
+            return _readonly_tree(self._center)
 
     @property
     def center(self) -> Pytree:

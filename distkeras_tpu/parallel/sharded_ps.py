@@ -68,7 +68,9 @@ from distkeras_tpu.parallel.host_ps import (
     _readonly_view,
     _to_numpy,
     HostParameterServer,
+    pack_params,
     PSFencedError,
+    unpack_params,
 )
 from distkeras_tpu.parallel.update_rules import PSState, UpdateRule
 
@@ -195,6 +197,11 @@ class ShardedParameterServer:
                         for idx in self.plan]
         self._seen_lock = racecheck.lock("sharded_ps.seen")
         self._last_seen: dict[int, float] = {}
+        # hier_ps leaders: leader id -> (upstream seq, packed center)
+        # — group-level dedupe for pre-reduced window commits, kept
+        # apart from the per-shard tables (a group commit touches
+        # every shard atomically from the leader's point of view)
+        self._group_replies: dict[int, tuple[int, bytes]] = {}
         # replication (replicated_ps): same plain attributes as the
         # unsharded server — written at attach/fence, read per commit
         self.epoch = 0
@@ -257,6 +264,8 @@ class ShardedParameterServer:
             with shard.lock:
                 shard.last_reply.clear()
                 shard.reply_bytes = 0
+        with self._seen_lock:
+            self._group_replies.clear()
         self._set_reply_gauge()
 
     def _set_reply_gauge(self) -> None:
@@ -455,6 +464,93 @@ class ShardedParameterServer:
             s.lock.release()
             if seq is not None:
                 self._set_reply_gauge()
+
+    def commit_group(self, leader_id: int, fold: Pytree,
+                     staleness, workers,
+                     seq: int | None = None) -> Pytree:
+        """Sharded twin of ``HostParameterServer.commit_group``: the
+        pre-reduced window's leaves are added shard by shard (each
+        under its own lock, in shard order — the same discipline as a
+        full-tree commit), with dedupe at GROUP level keyed by the
+        leader's upstream seq.  Each shard's clock advances by the
+        constituent count and the staleness vector lands in every
+        shard's log (a group commit touches every shard, exactly like
+        a logical commit).  Returns the new full center."""
+        if self.rule.payload_kind != "delta":
+            raise ValueError(
+                f"hierarchical aggregation needs a delta-family "
+                f"rule; {type(self.rule).__name__} commits "
+                f"{self.rule.payload_kind!r} payloads")
+        if self._fenced:
+            raise PSFencedError(
+                f"commit rejected: this server was deposed (a newer "
+                f"primary holds epoch > {self.epoch})")
+        if self._replicator is not None:
+            raise RuntimeError(
+                "hierarchical upstream commits do not compose with "
+                "primary/standby replication (the standby replay "
+                "re-runs the rule's single-commit law, not the "
+                "group fold)")
+        fold_leaves = jax.tree_util.tree_leaves(_to_numpy(fold))
+        if len(fold_leaves) != self._n_leaves:
+            raise ValueError(
+                f"fold has {len(fold_leaves)} leaves, server "
+                f"template has {self._n_leaves}")
+        n = len(workers)
+        staleness = [int(s) for s in staleness]
+        m = telemetry.metrics()
+        with telemetry.span("ps_commit", worker=leader_id, fanin=n):
+            if seq is not None:
+                with self._seen_lock:
+                    last = self._group_replies.get(leader_id)
+                if last is not None and seq <= last[0]:
+                    self._stamp(leader_id)
+                    m.counter("ps_commit_dedup_total").inc()
+                    flight_recorder.record("commit_dedup",
+                                           worker=leader_id, seq=seq)
+                    return unpack_params(self.center, last[1])
+            hist = m.histogram("ps_commit_staleness",
+                               buckets=telemetry.STALENESS_BUCKETS)
+            for k, s in enumerate(self._shards):
+                with s.lock:
+                    s.center = [np.asarray(c + fold_leaves[i])
+                                for c, i in zip(s.center, s.idx)]
+                    s.clock += n
+                    s.pull_clock[leader_id] = s.clock
+                    s.staleness_log.extend(staleness)
+                    if len(s.staleness_log) > \
+                            self.STALENESS_LOG_WINDOW * 5 // 4:
+                        del s.staleness_log[:-self
+                                            .STALENESS_LOG_WINDOW]
+                    before = s.num_commits
+                    s.num_commits += n
+                    m.counter("ps_shard_commits_total").inc(n)
+                    if k == self.num_shards - 1:
+                        m.counter("ps_commits_total").inc(n)
+                        m.counter("ps_upstream_commits_total").inc()
+                        m.gauge("ps_fanin_reduction").set(n)
+                        for st in staleness:
+                            hist.observe(st)
+                        # lint: allow(blocking-call-under-lock):
+                        # acked => durable, same contract as
+                        # commit_shard's last-shard record
+                        flight_recorder.record(
+                            "commit", worker=leader_id, seq=seq,
+                            clock=s.clock, shards=self.num_shards,
+                            fanin=n,
+                            staleness=max(staleness, default=0))
+                        if (self._snapshot_every
+                                and s.num_commits
+                                // self._snapshot_every
+                                > before // self._snapshot_every):
+                            self._write_snapshot_holding(k)
+            center = self.center
+            if seq is not None:
+                with self._seen_lock:
+                    self._group_replies[leader_id] = (
+                        int(seq), pack_params(center))
+            self._stamp(leader_id)
+            return center
 
     # -- the full-tree face (in-process arm, PSClient compat) --------------
 

@@ -278,6 +278,10 @@ WIRE_OPS.register("replica", b"k", "kv_import")
 # dtype templates), then every block's raw leaf bytes back to back —
 # zero-copy on the send side (page memoryviews ride ``sendmsg``)
 WIRE_OPS.register("kv", b"K", "page_blocks")
+# hierarchical aggregation tier (hier_ps.HierPSServer._dispatch): one
+# pre-reduced group window — seq + per-worker staleness vector + the
+# folded delta — answered with the root's new center (ISSUE 20)
+WIRE_OPS.register("hier", b"u", "upstream_commit")
 
 
 # -- trace-context wire header (ISSUE 6) -------------------------------

@@ -1042,6 +1042,7 @@ DEFAULT_SLO_THRESHOLDS: dict[str, tuple[float, float]] = {
     "idle_worker_fraction": (0.34, 0.75),  # silent / registered
     "ps_lock_wait": (0.005, 0.05),        # lock-wait s / shard commit
     "failover_rate": (0.05, 0.5),         # gateway failovers / request
+    "leader_failover_rate": (0.05, 0.5),  # leader deaths / upstream
     "prefix_hit_rate": (0.10, 0.01),      # prefix-cache hits / lookup
     "ps_standby_lag": (32.0, 256.0),      # commit-log entries behind
     "preemption_rate": (0.25, 2.0),       # preemptions per request
@@ -1084,7 +1085,8 @@ class SLOWatchdog:
     The signals (PS staleness p99, client retry rate, serving shed
     rate, queue depth, TTFT p95/p99, inter-token p99, idle-worker
     fraction, gateway
-    failover rate, prefix hit rate, PS standby replication lag,
+    failover rate, hier leader failover rate, prefix hit rate, PS
+    standby replication lag,
     KV-page preemption rate, speculative accept rate, mesh-round MFU
     gap) are computed
     from the registry's live metrics and compared against ``(degraded_at, critical_at)``
@@ -1203,6 +1205,14 @@ class SLOWatchdog:
             # the gateway shows up here even while every request still
             # completes (the gateway hides the failures it absorbs)
             out["failover_rate"] = gfails / max(groutes, 1.0)
+        ups = r.sum_counter("ps_upstream_commits_total")
+        lfails = r.sum_counter("ps_leader_failovers_total")
+        if ups or lfails:
+            # workers degraded to direct-to-root mode per upstream
+            # window: the aggregation tier is alive but leaking its
+            # fan-in reduction — each degraded worker adds a full
+            # root commit per round the tier was built to absorb
+            out["leader_failover_rate"] = lfails / max(ups, 1.0)
         phits = r.sum_counter("serving_prefix_hits_total")
         pmiss = r.sum_counter("serving_prefix_misses_total")
         if phits or pmiss:

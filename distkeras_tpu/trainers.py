@@ -795,6 +795,7 @@ class DistributedTrainer(Trainer):
                  ps_replicas: list | None = None,
                  ps_shards: int = 1,
                  ps_elastic: bool = False,
+                 ps_groups: list | None = None,
                  ps_snapshot_path: str | None = None,
                  ps_snapshot_every: int = 0,
                  comm_dtype: str = "float32",
@@ -898,6 +899,27 @@ class DistributedTrainer(Trainer):
         compression does not compose (the elastic wire ships raw
         leaf bytes so resharding stays byte-exact).
 
+        ``ps_groups=[(leader_addr, [worker_ids...]), ...]`` (host arm,
+        socket, delta family) runs the two-level hierarchical topology
+        (``parallel.hier_ps``): each listed group's workers commit to
+        a ``GroupLeader`` that folds their deltas over an
+        ``aggregate_window`` (the group size) and forwards ONE
+        pre-reduced upstream commit per window, cutting root fan-in
+        from O(workers) to O(groups).  ``leader_addr`` is the
+        ``(host, port)`` the leader binds, or ``None`` for a
+        loopback-ephemeral bind; workers not listed in any group stay
+        direct-to-root.  A dead leader degrades its workers to
+        direct-to-root mode via a two-hop failover route (the
+        ``leader_down`` / ``leader_rejoin`` flight kinds and the
+        ``leader_failover_rate`` SLO); history grows
+        ``ps_upstream_commits`` / ``ps_fanin_reduction`` /
+        ``ps_leader_failovers``.  Composes with ``ps_shards`` (the
+        root runs sharded; upstream windows ship the full tree),
+        ``compression`` (the worker->leader hop), chaos and
+        snapshots; the trainer must own the root server
+        (mutually exclusive with ``ps_address`` / ``ps_replicas`` /
+        ``ps_elastic`` and multi-host).
+
         ``commit_overlap=True`` on the host
         arm double-buffers each worker's loop: the commit/pull
         exchange for window *n* runs on a background thread while the
@@ -990,6 +1012,46 @@ class DistributedTrainer(Trainer):
                     "compression does not compose with ps_elastic "
                     "(the elastic wire ships raw leaf bytes so "
                     "resharding stays byte-exact)")
+        self.ps_groups = None
+        if ps_groups is not None:
+            groups, seen_ids = [], set()
+            for entry in ps_groups:
+                leader_addr, members = entry
+                members = [int(m) for m in members]
+                if not members:
+                    raise ValueError(
+                        "every ps_groups entry needs at least one "
+                        "worker id")
+                for m in members:
+                    if not 0 <= m < self.num_workers:
+                        raise ValueError(
+                            f"ps_groups worker id {m} out of range "
+                            f"[0, {self.num_workers})")
+                    if m in seen_ids:
+                        raise ValueError(
+                            f"worker {m} appears in two ps_groups "
+                            f"entries")
+                    seen_ids.add(m)
+                addr = (None if leader_addr is None
+                        else (str(leader_addr[0]), int(leader_addr[1])))
+                groups.append((addr, members))
+            if not groups:
+                raise ValueError(
+                    "ps_groups needs at least one (leader_addr, "
+                    "[worker_ids...]) entry")
+            self.ps_groups = groups
+            if transport != "socket":
+                raise ValueError(
+                    "ps_groups runs group leaders as TCP servers "
+                    "fronting their workers; it requires "
+                    f"transport='socket', got {transport!r}")
+            if (ps_address is not None or ps_replicas is not None
+                    or self.ps_elastic):
+                raise ValueError(
+                    "ps_groups needs the trainer-owned root server "
+                    "(its HierPSServer speaks the upstream op); it "
+                    "is mutually exclusive with ps_address / "
+                    "ps_replicas / ps_elastic")
         self.ps_snapshot_path = ps_snapshot_path
         self.ps_snapshot_every = int(ps_snapshot_every)
         # on-chip comm knobs (mesh tier): lowered INSIDE the compiled
@@ -1033,12 +1095,14 @@ class DistributedTrainer(Trainer):
                                          or ps_replicas is not None
                                          or self.ps_shards > 1
                                          or self.ps_elastic
+                                         or ps_groups is not None
                                          or ps_snapshot_path is not None
                                          or self.ps_snapshot_every):
             raise ValueError(
                 "max_worker_failures / worker_retries / worker_timeout "
                 "/ fault_injector / compression / ps_address / "
-                "ps_replicas / ps_shards / ps_snapshot_* apply only to "
+                "ps_replicas / ps_shards / ps_groups / ps_snapshot_* "
+                "apply only to "
                 "fidelity='host' (the compiled tiers are "
                 "deterministic; recover via checkpoint/resume), got "
                 f"fidelity={fidelity!r}; concurrent tiers: "
@@ -1730,6 +1794,11 @@ class DistributedTrainer(Trainer):
                 raise ValueError(
                     "ps_replicas does not compose with multi-host "
                     "runs (process 0 hosts the PS there)")
+            if self.ps_groups is not None:
+                raise ValueError(
+                    "ps_groups does not compose with multi-host runs "
+                    "(group leaders run as threads of the single "
+                    "driver process)")
 
         shard_plan = None
         if self.ps_shards > 1:
@@ -1758,7 +1827,14 @@ class DistributedTrainer(Trainer):
                     rule, center, snapshot_path=self.ps_snapshot_path,
                     snapshot_every=self.ps_snapshot_every)
             if self.transport == "socket":
-                server = PSServer(
+                server_cls = PSServer
+                if self.ps_groups is not None:
+                    # root must understand the leaders' upstream op
+                    from distkeras_tpu.parallel.hier_ps import (
+                        HierPSServer)
+
+                    server_cls = HierPSServer
+                server = server_cls(
                     ps, center,
                     host="0.0.0.0" if multi else "127.0.0.1").start()
         if multi:
@@ -1792,6 +1868,32 @@ class DistributedTrainer(Trainer):
         else:
             ps_address = server.address if server is not None else None
 
+        # Hierarchical aggregation (parallel.hier_ps): one in-process
+        # GroupLeader per ps_groups entry fronts its workers and folds
+        # their windows into single upstream commits against the root.
+        leaders: list = []
+        group_of: dict[int, int] = {}
+        if self.ps_groups is not None:
+            from distkeras_tpu.parallel.hier_ps import (
+                GroupLeader, resilient_hier_client)
+
+            if rule.payload_kind != "delta":
+                raise ValueError(
+                    "ps_groups supports the delta-family rules only "
+                    "(DOWNPOUR/ADAG/DynSGD): leaders fold additive "
+                    "payloads; the elastic exchange has no "
+                    "closed-form combination")
+            for gi, (addr, members) in enumerate(self.ps_groups):
+                leader = GroupLeader(
+                    rule, center, ps_address, group_id=gi,
+                    aggregate_window=len(members),
+                    host=addr[0] if addr is not None else "127.0.0.1",
+                    port=addr[1] if addr is not None else 0)
+                leader.start()
+                leaders.append(leader)
+                for m in members:
+                    group_of[m] = gi
+
         step = make_train_step(self.model, self.loss, tx,
                                self.features_col, self.label_col)
         run_window = jax.jit(make_window_runner(step))
@@ -1810,6 +1912,7 @@ class DistributedTrainer(Trainer):
         skip_total = telemetry.Counter()    # version-delta pull savings
         saved_total = telemetry.Counter()   # (sharded socket arm)
         failover_total = telemetry.Counter()  # ps_replicas client arm
+        leader_failover_total = telemetry.Counter()  # ps_groups arm
 
         # Threads free-run through epochs, so the per-epoch shuffle +
         # repartition is memoized under a lock: the first worker to
@@ -1966,7 +2069,13 @@ class DistributedTrainer(Trainer):
             shard_stats = ({"pull_shards_skipped": 0,
                             "pull_bytes_saved": 0}
                            if sharded_socket else None)
-            if self.ps_elastic:
+            gi = group_of.get(w)
+            if gi is not None:
+                # grouped worker: leader first, root on leader death
+                client = resilient_hier_client(
+                    leaders[gi].address, ps_address, worker_id=w,
+                    template=center, codec=codec, **retry_kw)
+            elif self.ps_elastic:
                 client = ResilientPSClient.for_elastic(
                     [ps_address], worker_id=w, template=center,
                     stats=shard_stats, **retry_kw)
@@ -2199,6 +2308,9 @@ class DistributedTrainer(Trainer):
                     # the cycler survives reconnects, so its count is
                     # this worker's whole-run failover total
                     failover_total.inc(client.replicas.failovers)
+                if group_of.get(w) is not None:
+                    leader_failover_total.inc(
+                        client.replicas.failovers)
 
         threads = [threading.Thread(target=worker_loop, args=(w,))
                    for w in local_workers]
@@ -2215,12 +2327,20 @@ class DistributedTrainer(Trainer):
         if self.worker_timeout is not None and ps is not None:
             for w in range(num_workers):
                 # monitor from t=0: a worker hanging before its first
-                # PS contact must be flagged, not invisible
-                ps.register(w)
+                # PS contact must be flagged, not invisible; grouped
+                # workers heartbeat at their leader, not the root
+                gi = group_of.get(w)
+                (leaders[gi] if gi is not None else ps).register(w)
 
             def watchdog():
                 while not stop_watch.wait(self.worker_timeout / 4):
-                    idle = ps.idle_workers(self.worker_timeout)
+                    seen = set(ps.idle_workers(self.worker_timeout))
+                    for lead in leaders:
+                        seen.update(
+                            lead.idle_workers(self.worker_timeout))
+                    # leader ids live in their own space above the
+                    # worker range; only workers are paged on
+                    idle = sorted(i for i in seen if i < num_workers)
                     if idle and (not detected or detected[-1] != idle):
                         detected.append(idle)
                         # timeline marker on the watchdog's own track
@@ -2245,6 +2365,11 @@ class DistributedTrainer(Trainer):
                 watcher.join()
         if detected:
             self._record(detected_idle_workers=detected)
+        for lead in leaders:
+            # drain flushes any partial window upstream so the root
+            # center (the deliverable) holds every acked commit
+            lead.drain()
+            lead.stop()
         if server is not None:
             server.stop()
         # threads are joined: snapshot the shared accumulators once
@@ -2273,6 +2398,13 @@ class DistributedTrainer(Trainer):
             self._record(worker_round_retries=retry_records)
         if ps is not None and ps.num_snapshots:
             self._record(ps_snapshots=ps.num_snapshots)
+        if leaders:
+            total_folded = sum(l.num_commits for l in leaders)
+            ups = sum(l.num_upstream for l in leaders)
+            self._record(
+                ps_upstream_commits=ups,
+                ps_fanin_reduction=total_folded / max(ups, 1),
+                ps_leader_failovers=int(leader_failover_total.value))
         if codec is not None:
             self._record(commit_wire_bytes=int(wire_total.value),
                          commit_raw_bytes=int(raw_total.value))

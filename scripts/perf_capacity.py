@@ -12,7 +12,11 @@ Two phases, one report:
    rate ladder until error-free SLO attainment (TTFT at the fixed
    ``--slo-ttft``) breaks.  The passing rungs fit a ``CapacityModel``
    — sustainable QPS vs replicas — published as
-   ``sim_capacity_qps{replicas=N}`` gauges.
+   ``sim_capacity_qps{replicas=N}`` gauges.  A second axis reruns the
+   search at one replica with the CONCURRENT socket-PS training
+   tenant flat vs hierarchical (``ps_groups``; ISSUE 20), pricing the
+   aggregation tier's co-tenant tax as
+   ``sim_capacity_qps{replicas=1,ps_groups=g}`` points.
 2. **Closed-loop drill** — a diurnal trace with a flash crowd runs
    against a 1-replica gateway plus a pre-warmed ``ReplicaPool``; the
    ``telemetry.Autoscaler`` (queue-depth SLO breaches only, busy-guard
@@ -167,6 +171,60 @@ def run_capacity_phase(model, variables, args):
     return CapacityModel(points), searches
 
 
+def run_hier_axis_phase(model, variables, args, cap_model):
+    """Second capacity axis (ISSUE 20 satellite; the ROADMAP item 3
+    leftover): sustainable serving QPS at ONE replica while the
+    concurrent socket-PS training tenant runs flat (``ps_groups=0``)
+    vs hierarchical (GroupLeader topology) at the same worker count —
+    the sweep prices the aggregation tier's co-tenant CPU tax, and
+    each point lands on ``sim_capacity_qps{replicas=1,ps_groups=g}``
+    (the extra config key flows into the gauge labels)."""
+    from distkeras_tpu.gateway import EngineReplica, ServingGateway
+    from distkeras_tpu.simulator import stepped_rate_search
+
+    c1 = cap_model.capacity(1)
+    ladder = tuple(sorted({max(1.0, c1 / 4), max(1.0, c1 / 2),
+                           max(1.0, c1)}))
+    axis = []
+    for groups in sorted(args.hier_configs):
+        workers = 4
+        g = workers // groups if groups else 0
+        ps_groups = ([(None, list(range(i * g, (i + 1) * g)))
+                      for i in range(groups)] if groups else None)
+        rep = EngineReplica(_warmed_engine(model, variables, args),
+                            name=f"hier-g{groups}")
+        stop = threading.Event()
+        stats = {"runs": 0, "rounds": 0, "commits": 0, "errors": []}
+        trainer = threading.Thread(
+            target=_training_tenant, args=(stop, stats, args.rows),
+            kwargs={"ps_groups": ps_groups, "num_workers": workers},
+            daemon=True)
+        with ServingGateway([rep], policy="least_loaded", retries=8,
+                            backoff_base=0.01) as gw:
+            warm_ids = [gw.submit(
+                np.arange(args.prompt_min, dtype=np.int32)
+                % args.vocab, max_new_tokens=args.output_min)
+                for _ in range(2)]
+            for rid in warm_ids:
+                gw.result(rid, timeout=30.0)
+            trainer.start()
+            search = stepped_rate_search(
+                gw, _base_spec(args), slo_ttft_s=args.slo_ttft,
+                attainment=args.attainment, ladder=ladder,
+                min_arrivals=args.min_arrivals,
+                max_segment_s=args.max_segment,
+                drain_timeout_s=args.drain_timeout,
+                config={"replicas": 1, "ps_groups": groups})
+            stop.set()
+            trainer.join(60)
+            _wait_idle([rep])
+        axis.append({"ps_groups": groups,
+                     "sustainable_qps": search["sustainable_qps"],
+                     "capped": search["capped"],
+                     "train": dict(stats)})
+    return axis
+
+
 def _drill_watchdog(registry):
     """Queue-depth-only SLO: every other signal is disabled so the
     drill's violation accounting is purely load-driven (and recovers
@@ -184,10 +242,13 @@ def _drill_watchdog(registry):
                        sustain_secs=0.2)
 
 
-def _training_tenant(stop, stats, rows):
+def _training_tenant(stop, stats, rows, ps_groups=None,
+                     num_workers=2):
     """The concurrent train tenancy: socket-PS DOWNPOUR rounds looping
     until the drill ends, each run asserted exactly-once (commits ==
-    rounds) even while the chaos window resets/delays its wire."""
+    rounds) even while the chaos window resets/delays its wire.
+    ``ps_groups`` runs the same tenancy through the hierarchical
+    GroupLeader topology (the second capacity axis)."""
     from distkeras_tpu.data import datasets
     from distkeras_tpu.models import model_config
     from distkeras_tpu.trainers import DOWNPOUR
@@ -197,9 +258,10 @@ def _training_tenant(stop, stats, rows):
     while not stop.is_set():
         try:
             t = DOWNPOUR(mlp, fidelity="host", transport="socket",
-                         num_workers=2, communication_window=2,
+                         num_workers=num_workers,
+                         communication_window=2,
                          batch_size=16, num_epoch=1,
-                         learning_rate=0.01,
+                         learning_rate=0.01, ps_groups=ps_groups,
                          worker_optimizer="adam", worker_retries=14)
             t.train(data)
             rounds = len(t.history["round_loss"])
@@ -358,6 +420,10 @@ def main():
     ap.add_argument("--output-max", type=int, default=64)
     ap.add_argument("--replica-configs", default="1,2",
                     help="comma-separated replica counts to probe")
+    ap.add_argument("--hier-configs", default="0,2",
+                    help="comma-separated training-tenant ps_groups "
+                         "counts for the second capacity axis "
+                         "(0 = flat topology)")
     ap.add_argument("--ladder", default="6,12,24,48,96,192",
                     help="comma-separated QPS rungs")
     ap.add_argument("--slo-ttft", type=float, default=0.3,
@@ -392,6 +458,7 @@ def main():
         args.rows = 160
     args.replica_configs = [int(x) for x
                             in args.replica_configs.split(",")]
+    args.hier_configs = [int(x) for x in args.hier_configs.split(",")]
     args.ladder = [float(x) for x in args.ladder.split(",")]
 
     out_dir = pathlib.Path(args.out_dir
@@ -406,6 +473,9 @@ def main():
     # ---- phase 1: capacity --------------------------------------------
     telemetry.enable()
     cap_model, searches = run_capacity_phase(model, variables, args)
+    # second axis: the training tenant's PS topology (flat vs
+    # hierarchical ps_groups) at fixed replicas=1
+    hier_axis = run_hier_axis_phase(model, variables, args, cap_model)
     telemetry.metrics().snapshot()  # phase A registry, then reset
     telemetry.disable()
 
@@ -425,6 +495,7 @@ def main():
 
     out = {"metric": "traffic_capacity_drill",
            "capacity": cap_model.describe(),
+           "hier_axis": hier_axis,
            "searches": [{k: s[k] for k in ("sustainable_qps",
                                            "capped", "rungs")}
                         for s in searches],
@@ -492,6 +563,13 @@ def main():
         # a fitted capacity point per probed config, none ladder-capped
         assert len(cap_model.points) == len(args.replica_configs)
         assert cap_model.capacity(1) > 0
+        # the second axis measured every ps_groups config with its
+        # training tenant exactly-once (flat AND hierarchical)
+        assert len(hier_axis) == len(args.hier_configs)
+        for pt in hier_axis:
+            assert pt["sustainable_qps"] > 0, pt
+            assert pt["train"]["runs"] >= 1, pt
+            assert not pt["train"]["errors"], pt["train"]["errors"]
         assert not any(s["capped"] for s in searches), (
             "the rate ladder never saturated — raise the top rung")
         # the closed-loop drill converged: every deficit episode

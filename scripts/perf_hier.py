@@ -1,0 +1,366 @@
+"""Hierarchical-PS A/B: flat single-root socket PS vs GroupLeader
+tree (ISSUE 20).
+
+The single-root socket PS is the reference's known scalability
+ceiling (PERF.md §12): every worker commit lands on one server, so
+root load grows linearly with W.  ``parallel.hier_ps`` puts a
+``GroupLeader`` in front of every g workers; the leader folds their
+windows with the rule's closed-form combination and forwards ONE
+upstream commit — the fold is the SAME SIZE as a single delta, so
+root message count AND root bytes both drop exactly g×.
+
+Part 1 — byte-exact parity + fan-in accounting: the same seeded
+dyadic commit schedule through both topologies over real sockets;
+asserts the final centers are byte-identical, the root applied every
+logical commit, and the root saw exactly W/g upstream messages per
+round carrying g× fewer bytes.
+
+Part 2 — root-bound throughput A/B: on one box both arms share the
+same cores, so the fan-in win is surfaced by modeling what the
+hierarchy actually relieves — the root's fixed link capacity (the
+§12 ceiling).  A shared serial token link charges every root-hop
+message its actual packed in+out bytes at a fixed byte rate,
+identically in both arms; the leader hop runs unthrottled.  Flat
+pushes W×rounds messages through that link, hierarchical W/g — the
+measured aggregate commit throughput ratio is the fan-in reduction
+made visible.  The unthrottled wall-clock ratio is reported
+alongside (informational: with leaders and root sharing one CPU the
+extra fold tier costs, not saves; the hierarchy pays off where root
+capacity, not worker CPU, binds).
+
+``--smoke`` (tier-1 via test_examples.py SMOKE_SCRIPTS) runs the
+W=16, g=4 cell, asserts parity / exact fan-in / ≥2× root-bound
+throughput, and gates the numbers through ``perf_regress`` (pass +
+forced breach in both directions), emitting trajectory-format
+BENCH records:
+    JAX_PLATFORMS=cpu PYTHONPATH=/root/repo \
+        python scripts/perf_hier.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import numpy as np
+
+import perf_regress
+
+
+def _dyadic_center(leaves=6, dim=64, seed=0):
+    """Dyadic-rational center: every leaf a multiple of 2^-6, well
+    inside f32's 24-bit mantissa, so float addition is EXACT in any
+    association order — the flat-vs-hier byte-identity assert tests
+    the topology, not float reassociation."""
+    rng = np.random.default_rng(seed)
+    return {f"w{i}": (rng.integers(-512, 512, size=(dim, dim))
+                      * 2.0 ** -6).astype(np.float32)
+            for i in range(leaves)}
+
+
+def _dyadic_delta(center, w, r):
+    val = np.float32((((w * 7 + r) % 13) - 6) * 2.0 ** -6)
+    return {k: np.full_like(v, val) for k, v in center.items()}
+
+
+class _RootLink:
+    """The root's modeled fixed-capacity serial link: a shared lock
+    (one message at a time — a link, not a thread pool) charging
+    actual bytes at ``bytes_per_s``.  Byte/message totals double as
+    the fan-in accounting."""
+
+    def __init__(self, bytes_per_s: float | None):
+        self.bytes_per_s = bytes_per_s
+        self._lock = threading.Lock()
+        self.nbytes = 0
+        self.msgs = 0
+
+    def charge(self, nbytes: int) -> None:
+        with self._lock:
+            self.nbytes += nbytes
+            self.msgs += 1
+            if self.bytes_per_s:
+                time.sleep(nbytes / self.bytes_per_s)
+
+
+class _RootThrottled:
+    """PS proxy metering the root hop: every commit-path message
+    (payload/fold in + center reply out) crosses the shared link
+    before the real server applies it.  Identical in both arms —
+    only the MESSAGE COUNT differs by topology."""
+
+    def __init__(self, ps, link: _RootLink, msg_bytes: int):
+        self._ps = ps
+        self._link = link
+        self._msg_bytes = msg_bytes
+
+    def __getattr__(self, name):
+        return getattr(self._ps, name)
+
+    def commit(self, worker_id, payload, local=None, seq=None):
+        self._link.charge(self._msg_bytes)
+        return self._ps.commit(worker_id, payload, local, seq=seq)
+
+    def commit_packed(self, worker_id, payload, local=None, seq=None):
+        # the socket handler prefers this path — meter it too, or the
+        # flat arm would bypass the link entirely
+        self._link.charge(self._msg_bytes)
+        return self._ps.commit_packed(worker_id, payload, local,
+                                      seq=seq)
+
+    def commit_group(self, leader_id, fold, staleness, workers,
+                     seq=None):
+        # the leader's fold is the same packed size as one delta
+        self._link.charge(self._msg_bytes)
+        return self._ps.commit_group(leader_id, fold, staleness,
+                                     workers, seq=seq)
+
+
+def _hammer(center, addresses, rounds):
+    """W socket workers (one per address entry), each pull + the
+    seeded dyadic commit schedule; returns commits/sec."""
+    from distkeras_tpu.parallel.host_ps import PSClient
+
+    workers = len(addresses)
+    barrier = threading.Barrier(workers + 1)
+    errs = []
+
+    def worker(w):
+        try:
+            client = PSClient(*addresses[w], w, center)
+            client.pull()
+            barrier.wait()
+            for r in range(rounds):
+                client.commit(_dyadic_delta(center, w, r), seq=r)
+            client.close()
+        except Exception as e:  # surfaced after join
+            errs.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    return workers * rounds / dt, dt
+
+
+def _msg_bytes(center) -> int:
+    """Packed in+out bytes of one root message (delta up, center
+    reply down — identical trees, identical size)."""
+    from distkeras_tpu.parallel.host_ps import pack_params
+
+    return 2 * len(pack_params(center))
+
+
+def run_flat(center, workers, rounds, link):
+    from distkeras_tpu.parallel.host_ps import (HostParameterServer,
+                                                PSServer)
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    ps = HostParameterServer(DownpourRule(), center)
+    server = PSServer(_RootThrottled(ps, link, _msg_bytes(center)),
+                      center).start()
+    cps, dt = _hammer(center, [server.address] * workers, rounds)
+    final = {k: np.asarray(v).copy() for k, v in ps.center.items()}
+    commits = ps.num_commits
+    server.stop()
+    return {"commits_per_sec": cps, "seconds": dt, "center": final,
+            "root_commits": commits, "root_msgs": link.msgs,
+            "root_bytes": link.nbytes}
+
+
+def run_hier(center, workers, groups, rounds, link):
+    from distkeras_tpu.parallel.hier_ps import (GroupLeader,
+                                                HierPSServer)
+    from distkeras_tpu.parallel.host_ps import HostParameterServer
+    from distkeras_tpu.parallel.update_rules import DownpourRule
+
+    g = workers // groups
+    ps = HostParameterServer(DownpourRule(), center)
+    root = HierPSServer(_RootThrottled(ps, link, _msg_bytes(center)),
+                        center).start()
+    leaders = [GroupLeader(DownpourRule(), center, root.address,
+                           group_id=gi, aggregate_window=g).start()
+               for gi in range(groups)]
+    addrs = [leaders[w // g].address for w in range(workers)]
+    cps, dt = _hammer(center, addrs, rounds)
+    for lead in leaders:
+        lead.drain()
+        lead.stop()
+    final = {k: np.asarray(v).copy() for k, v in ps.center.items()}
+    out = {"commits_per_sec": cps, "seconds": dt, "center": final,
+           "root_commits": ps.num_commits, "root_msgs": link.msgs,
+           "root_bytes": link.nbytes,
+           "upstream_commits": sum(l.num_upstream for l in leaders),
+           "folded_commits": sum(l.num_commits for l in leaders)}
+    root.stop()
+    return out
+
+
+def ab_cell(center, workers, groups, rounds, link_bytes_per_s):
+    """One A/B cell through both topologies; parity + fan-in checks
+    are structural, so every cell asserts them."""
+    g = workers // groups
+    flat_link = _RootLink(link_bytes_per_s)
+    hier_link = _RootLink(link_bytes_per_s)
+    flat = run_flat(center, workers, rounds, flat_link)
+    hier = run_hier(center, workers, groups, rounds, hier_link)
+
+    # byte-exact parity: same seeded schedule, dyadic values — any
+    # difference is a topology bug, not float reassociation
+    for k in center:
+        assert (flat["center"][k].tobytes()
+                == hier["center"][k].tobytes()), (
+            f"flat/hier centers diverge at leaf {k!r}")
+    total = workers * rounds
+    assert flat["root_commits"] == hier["root_commits"] == total, (
+        flat["root_commits"], hier["root_commits"], total)
+    assert hier["upstream_commits"] == hier["root_msgs"] == total // g
+    assert hier["folded_commits"] == total
+    # the fold is one delta wide: bytes drop exactly g× with messages
+    assert flat["root_bytes"] == g * hier["root_bytes"], (
+        flat["root_bytes"], hier["root_bytes"], g)
+
+    return {
+        "bench": "hier_ab", "workers": workers, "groups": groups,
+        "group_size": g, "rounds": rounds,
+        "link_mb_per_s": (round(link_bytes_per_s / 1e6, 1)
+                          if link_bytes_per_s else None),
+        "flat_commits_per_sec": round(flat["commits_per_sec"], 1),
+        "hier_commits_per_sec": round(hier["commits_per_sec"], 1),
+        "speedup": round(hier["commits_per_sec"]
+                         / flat["commits_per_sec"], 2),
+        "root_msgs_flat": flat["root_msgs"],
+        "root_msgs_hier": hier["root_msgs"],
+        "fanin_reduction": flat["root_msgs"] / hier["root_msgs"],
+        "root_mb_flat": round(flat["root_bytes"] / 1e6, 2),
+        "root_mb_hier": round(hier["root_bytes"] / 1e6, 2),
+        "hier_seconds": hier["seconds"],
+    }
+
+
+def full(rounds=8):
+    center = _dyadic_center(leaves=8, dim=128)
+    for link in (None, 50e6, 10e6):
+        for groups in (2, 4, 8):
+            row = ab_cell(center, workers=16, groups=groups,
+                          rounds=rounds, link_bytes_per_s=link)
+            print(json.dumps(row), flush=True)
+
+
+def smoke(out_dir: str | None = None):
+    """Seconds-scale W=16/g=4 cell with the full assertion set +
+    perf_regress gate (tier-1)."""
+    from distkeras_tpu import telemetry
+
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory()
+        out_dir = tmp.name
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    center = _dyadic_center(leaves=6, dim=64)
+
+    # informational: same cell unthrottled (one shared CPU — the
+    # extra fold tier costs; the fan-in win is a root-capacity story)
+    raw = ab_cell(center, workers=16, groups=4, rounds=4,
+                  link_bytes_per_s=None)
+    print(json.dumps({**raw, "bench": "hier_ab_unthrottled"}),
+          flush=True)
+
+    tel = telemetry.enable()
+    # the measured claim: root link at a fixed byte rate, W=16 g=4 —
+    # hierarchical aggregate commit throughput ≥ 2× flat
+    row = ab_cell(center, workers=16, groups=4, rounds=4,
+                  link_bytes_per_s=8e6)
+    print(json.dumps(row), flush=True)
+    assert row["fanin_reduction"] == 4.0, row
+    assert row["speedup"] >= 2.0, (
+        f"root-bound hierarchical speedup {row['speedup']} < 2.0")
+
+    snap_path = out / "registry.json"
+    snap_path.write_text(json.dumps(tel.metrics.snapshot(),
+                                    default=repr))
+    telemetry.disable()
+
+    # ---- perf_regress hookup: upstream commit rate from the live
+    # registry, the A/B speedup (higher is better), and root bytes
+    # per logical commit (lower is better)
+    cands = perf_regress.from_registry(
+        str(snap_path), "hier_upstream_commits_per_sec",
+        "ps_upstream_commits_total", row["hier_seconds"])
+    assert cands[0]["value"] > 0, cands
+    cands.append({"metric": "hier_speedup_vs_flat",
+                  "value": row["speedup"], "unit": "x"})
+    lower = [{"metric": "hier_root_bytes_per_commit",
+              "value": row["root_mb_hier"] * 1e6 / (16 * 4),
+              "unit": "bytes"}]
+    for i, c in enumerate(cands + lower):
+        for n in (1, 2, 3):  # synthetic trajectory from this run
+            (out / f"BENCH_hier{i}_r{n:02d}.json").write_text(
+                json.dumps({
+                    "n": n, "cmd": "smoke", "rc": 0, "tail": "",
+                    "parsed": {"metric": c["metric"],
+                               "value": c["value"] * (1 + 0.02 * n),
+                               "unit": c.get("unit", "per_sec")}}))
+    traj = perf_regress.load_trajectories(
+        str(out / "BENCH_hier*.json"))
+    rows = (perf_regress.evaluate(cands, traj, tolerance=0.5)
+            + perf_regress.evaluate(lower, traj, tolerance=0.5,
+                                    lower_is_better=True))
+    print(perf_regress.render(rows))
+    assert all(r["status"] == "pass" for r in rows), rows
+    # forced breach, both directions: a collapsed rate and ballooned
+    # root bytes must each trip the gate
+    bad_hi = perf_regress.evaluate(
+        [{"metric": cands[0]["metric"],
+          "value": cands[0]["value"] / 10.0}], traj, tolerance=0.5)
+    assert bad_hi[0]["status"] == "breach", bad_hi
+    bad_lo = perf_regress.evaluate(
+        [{"metric": lower[0]["metric"],
+          "value": lower[0]["value"] * 10.0}], traj, tolerance=0.5,
+        lower_is_better=True)
+    assert bad_lo[0]["status"] == "breach", bad_lo
+    print(json.dumps({"smoke": "ok"}), flush=True)
+    if tmp is not None:
+        tmp.cleanup()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="directory for the gate's BENCH records "
+                         "(smoke; default: a temp dir)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale W=16/g=4 parity + fan-in + "
+                         "root-bound throughput gate (tier-1)")
+    args = ap.parse_args()
+    if args.smoke:
+        smoke(args.out)
+        return
+    full(rounds=args.rounds)
+
+
+if __name__ == "__main__":
+    main()

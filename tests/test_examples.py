@@ -32,6 +32,7 @@ SMOKE_SCRIPTS = {
     "perf_capacity.py": ["--smoke"],
     "perf_elastic.py": ["--smoke"],
     "perf_gateway.py": ["--smoke"],
+    "perf_hier.py": ["--smoke"],
     "perf_host_ps.py": ["--smoke"],
     "perf_mesh_comm.py": ["--smoke"],
     "perf_paging.py": ["--smoke"],

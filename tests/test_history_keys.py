@@ -103,6 +103,13 @@ def _collect_emitted() -> set[str]:
                  num_workers=2, communication_window=2, batch_size=16,
                  num_epoch=1, learning_rate=0.01,
                  commit_overlap=True))
+
+    # hierarchical host arm: group leaders fold worker windows into
+    # single upstream commits (the fan-in reduction keys, ISSUE 20)
+    run(DOWNPOUR(MLP, fidelity="host", transport="socket",
+                 ps_groups=[(None, [0, 1]), (None, [2, 3])],
+                 num_workers=4, communication_window=2, batch_size=8,
+                 num_epoch=1, learning_rate=0.01))
     return emitted
 
 

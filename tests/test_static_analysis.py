@@ -553,11 +553,13 @@ def test_wire_ops_rejects_multibyte():
 
 def test_repo_registry_covers_every_protocol():
     assert set(WIRE_OPS.scopes()) == {"frame", "ps", "replica",
-                                      "repl", "elastic"}
+                                      "repl", "elastic", "kv", "hier"}
     assert WIRE_OPS.ops("ps")[b"p"] == "pull"
     assert WIRE_OPS.ops("replica")[b"g"] == "generate"
     assert WIRE_OPS.ops("repl")[b"a"] == "append"
     assert WIRE_OPS.ops("elastic")[b"F"] == "migrate_finalize"
+    assert WIRE_OPS.ops("kv")[b"K"] == "page_blocks"
+    assert WIRE_OPS.ops("hier")[b"u"] == "upstream_commit"
 
 
 # -- runtime lockset race + deadlock detector --------------------------
